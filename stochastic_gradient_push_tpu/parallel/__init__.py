@@ -1,0 +1,31 @@
+"""Mesh construction and gossip collectives."""
+
+from .mesh import (
+    GOSSIP_AXIS,
+    LOCAL_AXIS,
+    NODE_AXIS,
+    make_gossip_mesh,
+    make_hierarchical_mesh,
+)
+from .collectives import (
+    allreduce_mean,
+    allreduce_sum,
+    gossip_round,
+    mix_bilat,
+    mix_push_pull,
+    mix_push_sum,
+)
+
+__all__ = [
+    "GOSSIP_AXIS",
+    "NODE_AXIS",
+    "LOCAL_AXIS",
+    "make_gossip_mesh",
+    "make_hierarchical_mesh",
+    "gossip_round",
+    "mix_push_sum",
+    "mix_push_pull",
+    "mix_bilat",
+    "allreduce_mean",
+    "allreduce_sum",
+]

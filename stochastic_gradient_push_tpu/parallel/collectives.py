@@ -1,0 +1,166 @@
+"""Gossip collectives: compiled replacements for the reference gossipers.
+
+The reference implements gossip as host-driven point-to-point transfers —
+``dist.broadcast`` on 2-member process groups fired from a background thread
+(gossiper.py:176-323, distributed.py:459-510).  Here each gossip round is a
+handful of ``lax.ppermute`` calls *inside the jitted train step*: the
+permutation tables come from a frozen :class:`GossipSchedule`, the traced
+phase index selects among them with ``lax.switch``, and XLA schedules the
+ICI transfers to overlap with compute.  There are no threads, locks, streams,
+heartbeats, or poison values — the entire class of hazards the reference
+hand-manages (SURVEY.md §5 "Race detection") does not exist in this design.
+
+All functions must be called inside ``shard_map``/``pjit`` with ``axis_name``
+bound to a mesh axis whose size equals ``schedule.world_size``.
+
+Correspondence to the reference:
+
+* :func:`mix_push_sum`  ≙ ``PushSum.mix``   (gossiper.py:176-219)
+* :func:`mix_push_pull` ≙ ``PushPull.mix``  (gossiper.py:222-275)
+* :func:`mix_bilat`     ≙ ``BilatPushPull.mix`` (gossiper.py:278-323),
+  in the synchronous perfect-matching formulation
+* :func:`allreduce_mean` ≙ the DDP AllReduce baseline (gossip_sgd.py:179-180)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..topology.schedule import GossipSchedule
+
+__all__ = [
+    "gossip_round",
+    "mix_push_sum",
+    "mix_push_pull",
+    "mix_bilat",
+    "allreduce_mean",
+    "allreduce_sum",
+]
+
+
+def _perm_pairs(dests: np.ndarray) -> list[tuple[int, int]]:
+    """ppermute (source, destination) pairs from a destination table."""
+    return [(int(src), int(dst)) for src, dst in enumerate(dests)]
+
+
+def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str):
+    """Build the mixing function for one static phase of the schedule."""
+    lo = float(schedule.self_weight[phase_idx])
+    edge_w = schedule.edge_weights[phase_idx]
+    perms = schedule.perms[phase_idx]
+
+    def fn(tree):
+        out = jax.tree.map(lambda a: a * jnp.asarray(lo, a.dtype), tree)
+        for i in range(schedule.peers_per_itr):
+            w_i = float(edge_w[i])
+            pairs = _perm_pairs(perms[i])
+            recv = jax.tree.map(
+                lambda a: lax.ppermute(
+                    a * jnp.asarray(w_i, a.dtype), axis_name, pairs),
+                tree)
+            out = jax.tree.map(jnp.add, out, recv)
+        return out
+
+    return fn
+
+
+def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str):
+    """One synchronous gossip round over an arbitrary pytree.
+
+    Computes ``lo * x + Σ_i ppermute(w_i * x, perm_i(phase))`` — the
+    column-stochastic mixing the reference assembles from weighted broadcasts
+    (gossiper.py:125-147, 191-215).  ``phase`` is a traced int32 scalar;
+    rotation (graph_manager.py:128-133) is a free modulo, not communicator
+    churn.
+    """
+    axis_size = lax.axis_size(axis_name)
+    if axis_size != schedule.world_size:
+        raise ValueError(
+            f"schedule was built for world_size={schedule.world_size} but "
+            f"mesh axis '{axis_name}' has size {axis_size}")
+    if schedule.world_size == 1:
+        return tree
+    if schedule.num_phases == 1:
+        return _round_fn(schedule, 0, axis_name)(tree)
+    branches = [_round_fn(schedule, p, axis_name)
+                for p in range(schedule.num_phases)]
+    return lax.switch(phase % schedule.num_phases, branches, tree)
+
+
+def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
+                 axis_name: str):
+    """Push-sum round: jointly mixes parameters and the push-sum weight.
+
+    The reference appends the scalar ps-weight to the flat payload only when
+    mixing is irregular (gossiper.py:83-85, 131-132); here it always rides
+    along as one extra pytree leaf — one scalar lane, zero bookkeeping.
+
+    Returns ``(mixed_params, mixed_ps_weight)``.  For regular schedules a
+    complete synchronous round maps ``ps_weight == 1 → 1``, which is the
+    algebraic form of the reference's lazy-mixing shortcut
+    (distributed.py:188-191).
+    """
+    mixed = gossip_round((params, ps_weight), phase, schedule, axis_name)
+    return mixed
+
+
+def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str):
+    """Doubly-stochastic (D-PSGD) round.
+
+    With uniform mixing on a regular graph the mixing matrix is doubly
+    stochastic, so no push-sum weight is needed — matches
+    ``PushPull.mix`` semantics (gossiper.py:222-275) where the active/passive
+    send ordering existed purely to avoid NCCL deadlock and has no analogue
+    in a compiled collective.
+    """
+    if not schedule.regular:
+        raise ValueError("push-pull requires a regular schedule "
+                         "(doubly-stochastic mixing)")
+    return gossip_round(params, phase, schedule, axis_name)
+
+
+def mix_bilat(params, phase, pairing: np.ndarray, axis_name: str):
+    """Bilateral pairwise averaging: ``x ← (x + x_partner) / 2``.
+
+    The synchronous formulation of AD-PSGD's bilateral exchange
+    (gossiper.py:278-323, ad_psgd.py:347-363): each phase is a perfect
+    matching (involution), so one ppermute moves both directions of every
+    pair simultaneously.
+    """
+    num_phases, world = pairing.shape
+    axis_size = lax.axis_size(axis_name)
+    if axis_size != world:
+        raise ValueError(
+            f"pairing was built for world_size={world} but mesh axis "
+            f"'{axis_name}' has size {axis_size}")
+    if world == 1:
+        return params
+
+    def branch(p):
+        pairs = _perm_pairs(pairing[p])
+
+        def fn(tree):
+            return jax.tree.map(
+                lambda a: (a + lax.ppermute(a, axis_name, pairs))
+                * jnp.asarray(0.5, a.dtype),
+                tree)
+        return fn
+
+    if num_phases == 1:
+        return branch(0)(params)
+    return lax.switch(phase % num_phases,
+                      [branch(p) for p in range(num_phases)], params)
+
+
+def allreduce_sum(tree, axis_name: str):
+    """Exact all-reduce sum (the AR baseline's collective)."""
+    return jax.tree.map(lambda a: lax.psum(a, axis_name), tree)
+
+
+def allreduce_mean(tree, axis_name: str):
+    """Exact all-reduce mean — replaces ``DistributedDataParallel``'s NCCL
+    gradient averaging (gossip_sgd.py:179-180)."""
+    return jax.tree.map(lambda a: lax.pmean(a, axis_name), tree)

@@ -1,0 +1,68 @@
+"""Device-mesh construction for decentralized data-parallel training.
+
+Replaces the reference's entire distributed bootstrap —
+``dist.init_process_group`` + per-edge process-group creation + NCCL
+communicator warm-up + NIC selection (gossip_sgd.py:586-690,
+graph_manager.py:22-32, experiment_utils/helpers.py:44-67).  On TPU none of
+that exists: devices are already connected over ICI, and a
+``jax.sharding.Mesh`` names the axes collectives run over.
+
+Two mesh shapes are provided:
+
+* ``make_gossip_mesh`` — a 1-D mesh over all devices; each device is one
+  gossip "rank" (the reference's one-process-per-GPU deployment).
+* ``make_hierarchical_mesh`` — a 2-D ``(node, local)`` mesh mirroring the
+  reference's ``nprocs_per_node`` grouping (distributed.py:62-78): exact
+  ``psum`` averaging inside a node (riding the fastest ICI links), gossip
+  between nodes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+GOSSIP_AXIS = "gossip"
+NODE_AXIS = "node"
+LOCAL_AXIS = "local"
+
+__all__ = ["GOSSIP_AXIS", "NODE_AXIS", "LOCAL_AXIS",
+           "make_gossip_mesh", "make_hierarchical_mesh"]
+
+
+def make_gossip_mesh(n_devices: int | None = None,
+                     devices=None) -> Mesh:
+    """1-D mesh: every device is an independent gossip rank."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (GOSSIP_AXIS,))
+
+
+def make_hierarchical_mesh(nprocs_per_node: int,
+                           n_devices: int | None = None,
+                           devices=None) -> Mesh:
+    """2-D ``(node, local)`` mesh for hierarchical gossip.
+
+    Gossip runs over ``node``; gradients/params are exactly averaged over
+    ``local`` with ``psum`` — the TPU counterpart of the reference's local
+    all-reduce group (distributed.py:278-296, 551-562).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % nprocs_per_node:
+        raise ValueError(
+            f"{n} devices not divisible by nprocs_per_node={nprocs_per_node}")
+    grid = np.asarray(devices).reshape(n // nprocs_per_node, nprocs_per_node)
+    return Mesh(grid, (NODE_AXIS, LOCAL_AXIS))

@@ -1,0 +1,47 @@
+"""Gossip communication topologies, mixing strategies, and compiled schedules."""
+
+from .graphs import (
+    GraphTopology,
+    DynamicDirectedExponentialGraph,
+    NPeerDynamicDirectedExponentialGraph,
+    DynamicBipartiteExponentialGraph,
+    DynamicDirectedLinearGraph,
+    DynamicBipartiteLinearGraph,
+    RingGraph,
+)
+from .mixing import MixingStrategy, UniformMixing
+from .schedule import GossipSchedule, build_schedule, build_pairing_schedule
+
+# Integer registry kept flag-compatible with the reference CLI
+# (gossip_sgd.py:54-67).
+GRAPH_TOPOLOGIES = {
+    0: DynamicDirectedExponentialGraph,
+    1: DynamicBipartiteExponentialGraph,
+    2: DynamicDirectedLinearGraph,
+    3: DynamicBipartiteLinearGraph,
+    4: RingGraph,
+    5: NPeerDynamicDirectedExponentialGraph,
+    -1: None,
+}
+
+MIXING_STRATEGIES = {
+    0: UniformMixing,
+    -1: None,
+}
+
+__all__ = [
+    "GraphTopology",
+    "DynamicDirectedExponentialGraph",
+    "NPeerDynamicDirectedExponentialGraph",
+    "DynamicBipartiteExponentialGraph",
+    "DynamicDirectedLinearGraph",
+    "DynamicBipartiteLinearGraph",
+    "RingGraph",
+    "MixingStrategy",
+    "UniformMixing",
+    "GossipSchedule",
+    "build_schedule",
+    "build_pairing_schedule",
+    "GRAPH_TOPOLOGIES",
+    "MIXING_STRATEGIES",
+]

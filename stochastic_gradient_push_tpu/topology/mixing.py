@@ -1,0 +1,57 @@
+"""Mixing-weight strategies for gossip averaging.
+
+Mirrors the semantics of ``/root/reference/gossip/mixing_manager.py:19-56``:
+a mixing strategy assigns, for the current set of out-peers, the weight kept
+locally (``lo``) and the weight attached to each outgoing message.  The
+reference returns a dict keyed by peer rank; here weights are plain floats
+arranged per rotation phase, ready to be baked into a jitted gossip round.
+
+``is_regular`` (mixing_manager.py:25-30) — uniform weights on a regular graph
+— is the condition under which the push-sum weight provably stays at 1.0
+after every *complete* synchronous gossip round, which the algorithm layer
+exploits the same way the reference's "lazy mixing" does
+(distributed.py:188-191), except here it falls out algebraically instead of
+via stateful bias/de-bias flags.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .graphs import GraphTopology
+
+__all__ = ["MixingStrategy", "UniformMixing"]
+
+
+class MixingStrategy:
+    """Assigns mixing weights to the local loopback and each out-edge."""
+
+    def is_uniform(self) -> bool:
+        raise NotImplementedError
+
+    def is_regular(self, graph: GraphTopology) -> bool:
+        """True iff the mixing matrix's stationary distribution is uniform,
+        i.e. no bias accumulates in the push-sum weight."""
+        return graph.is_regular_graph() and self.is_uniform()
+
+    def weights(self, graph: GraphTopology, phase: int
+                ) -> tuple[float, np.ndarray]:
+        """Returns ``(self_weight, edge_weights[peers_per_itr])`` for a phase.
+
+        Column-stochasticity — ``self_weight + edge_weights.sum() == 1`` —
+        is what push-sum requires for mass conservation.
+        """
+        raise NotImplementedError
+
+
+class UniformMixing(MixingStrategy):
+    """Uniform 1/(out_degree + 1) allocation (mixing_manager.py:41-56)."""
+
+    def is_uniform(self) -> bool:
+        return True
+
+    def weights(self, graph: GraphTopology, phase: int
+                ) -> tuple[float, np.ndarray]:
+        deg = graph.peers_per_itr if graph.world_size > 1 else 0
+        w = 1.0 / (deg + 1.0)
+        return w, np.full((deg,), w, dtype=np.float64)

@@ -1,0 +1,230 @@
+"""Property tests for gossip collectives on 8 virtual CPU devices.
+
+Push-sum invariants (SURVEY.md §4): mass conservation, consensus on static
+inputs, agreement with the numpy mixing-matrix simulator — the fake-backend
+test capability the reference lacks entirely.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.parallel import (
+    GOSSIP_AXIS,
+    allreduce_mean,
+    gossip_round,
+    mix_bilat,
+    mix_push_pull,
+    mix_push_sum,
+    make_gossip_mesh,
+)
+from stochastic_gradient_push_tpu.topology import (
+    DynamicBipartiteExponentialGraph,
+    DynamicDirectedExponentialGraph,
+    NPeerDynamicDirectedExponentialGraph,
+    RingGraph,
+    build_pairing_schedule,
+    build_schedule,
+)
+
+WORLD = 8
+
+
+def shard_gossip(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= WORLD, "conftest must fake 8 devices"
+    return make_gossip_mesh(WORLD)
+
+
+def _per_rank_values(seed=0, shape=(4, 3)):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(WORLD,) + shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("graph_cls,ppi", [
+    (NPeerDynamicDirectedExponentialGraph, 1),
+    (NPeerDynamicDirectedExponentialGraph, 2),
+    (DynamicDirectedExponentialGraph, 1),
+    (RingGraph, 1),
+])
+def test_gossip_round_matches_mixing_matrix(mesh, graph_cls, ppi):
+    sched = build_schedule(graph_cls(WORLD, peers_per_itr=ppi))
+    x = _per_rank_values(seed=1)
+
+    def step(phase, xs):
+        return gossip_round(xs, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh,
+                             (P(), P(GOSSIP_AXIS)), P(GOSSIP_AXIS)))
+    for phase in range(sched.num_phases + 1):
+        got = np.asarray(f(jnp.int32(phase), x))
+        W = sched.mixing_matrix(phase)
+        want = np.einsum("rs,s...->r...", W, x.astype(np.float64))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_mass_conservation(mesh):
+    """Σ_r x_r is invariant under any gossip round (column stochasticity)."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    x = _per_rank_values(seed=2)
+
+    def step(phase, xs):
+        return gossip_round(xs, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh,
+                             (P(), P(GOSSIP_AXIS)), P(GOSSIP_AXIS)))
+    total = x.sum(axis=0)
+    for phase in range(sched.num_phases):
+        x = np.asarray(f(jnp.int32(phase), x))
+        np.testing.assert_allclose(x.sum(axis=0), total, rtol=1e-4, atol=1e-4)
+
+
+def test_push_sum_consensus_on_static_input(mesh):
+    """Iterated push-sum drives de-biased values to the global mean."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    x = _per_rank_values(seed=3, shape=(5,))
+    w = np.ones((WORLD, 1), dtype=np.float32)
+
+    def step(phase, xs, ws):
+        return mix_push_sum(xs, ws, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(
+        step, mesh, (P(), P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+        (P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+
+    mean = x.mean(axis=0)
+    for phase in range(50):
+        x, w = map(np.asarray, f(jnp.int32(phase), x, w))
+    debiased = x / w
+    np.testing.assert_allclose(debiased,
+                               np.broadcast_to(mean, debiased.shape),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_push_sum_weight_stays_one_for_regular_schedule(mesh):
+    sched = build_schedule(
+        DynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    assert sched.regular
+    x = _per_rank_values(seed=4, shape=(2,))
+    w = np.ones((WORLD, 1), dtype=np.float32)
+
+    def step(phase, xs, ws):
+        return mix_push_sum(xs, ws, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(
+        step, mesh, (P(), P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+        (P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+    for phase in range(sched.num_phases):
+        x, w = map(np.asarray, f(jnp.int32(phase), x, w))
+        np.testing.assert_allclose(w, np.ones_like(w), rtol=1e-5)
+
+
+def test_bilat_round_pairwise_average(mesh):
+    graph = DynamicBipartiteExponentialGraph(WORLD)
+    pairing = build_pairing_schedule(graph)
+    x = _per_rank_values(seed=5, shape=(3,))
+
+    def step(phase, xs):
+        return mix_bilat(xs, phase, pairing, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh,
+                             (P(), P(GOSSIP_AXIS)), P(GOSSIP_AXIS)))
+    got = np.asarray(f(jnp.int32(0), x))
+    for r in range(WORLD):
+        partner = pairing[0, r]
+        np.testing.assert_allclose(got[r], 0.5 * (x[r] + x[partner]),
+                                   rtol=1e-6)
+
+    # iterating pairwise averaging over rotating matchings → consensus
+    y = x
+    for phase in range(40):
+        y = np.asarray(f(jnp.int32(phase), y))
+    np.testing.assert_allclose(
+        y, np.broadcast_to(x.mean(axis=0), y.shape), rtol=1e-3, atol=1e-3)
+
+
+def test_push_pull_doubly_stochastic_consensus(mesh):
+    """D-PSGD primitive: mean preserved every round, consensus at the end."""
+    import dataclasses
+
+    sched = build_schedule(DynamicBipartiteExponentialGraph(WORLD))
+    assert sched.regular
+    x = _per_rank_values(seed=11, shape=(3,))
+    mean = x.mean(axis=0)
+
+    def step(phase, xs):
+        return mix_push_pull(xs, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh,
+                             (P(), P(GOSSIP_AXIS)), P(GOSSIP_AXIS)))
+    for phase in range(40):
+        x = np.asarray(f(jnp.int32(phase), x))
+        # doubly-stochastic mixing preserves the *mean* exactly
+        np.testing.assert_allclose(x.mean(axis=0), mean, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(x, np.broadcast_to(mean, x.shape),
+                               rtol=1e-3, atol=1e-4)
+
+    # the regular-schedule gate: push-pull must reject irregular mixing
+    irregular = dataclasses.replace(sched, regular=False)
+    with pytest.raises(ValueError, match="regular"):
+        mix_push_pull(x[0], 0, irregular, GOSSIP_AXIS)
+
+
+def test_gossip_round_pytree(mesh):
+    """Gossip mixes arbitrary pytrees (the flatten/unflatten of helpers.py
+    :21-57 is unnecessary — XLA fuses per-leaf collectives)."""
+    sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
+    tree = {"a": _per_rank_values(seed=6, shape=(2, 2)),
+            "b": [_per_rank_values(seed=7, shape=(3,))]}
+
+    def step(phase, t):
+        return gossip_round(t, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh,
+                             (P(), P(GOSSIP_AXIS)), P(GOSSIP_AXIS)))
+    out = f(jnp.int32(0), tree)
+    W = sched.mixing_matrix(0)
+    for key, leaf in (("a", tree["a"]), ("b", tree["b"][0])):
+        got = np.asarray(out[key] if key == "a" else out["b"][0])
+        want = np.einsum("rs,s...->r...", W, leaf.astype(np.float64))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_mean(mesh):
+    x = _per_rank_values(seed=8)
+
+    def step(xs):
+        return allreduce_mean(xs, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh, (P(GOSSIP_AXIS),), P(GOSSIP_AXIS)))
+    got = np.asarray(f(x))
+    want = np.broadcast_to(x.mean(axis=0), x.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_no_recompilation_across_phases(mesh):
+    """Phase is traced: stepping through the rotation must not retrace."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    x = _per_rank_values(seed=9, shape=(2,))
+    traces = 0
+
+    def step(phase, xs):
+        nonlocal traces
+        traces += 1
+        return gossip_round(xs, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(shard_gossip(step, mesh,
+                             (P(), P(GOSSIP_AXIS)), P(GOSSIP_AXIS)))
+    for phase in range(6):
+        f(jnp.int32(phase), x)
+    assert traces == 1

@@ -1,0 +1,185 @@
+"""Unit tests for graph topologies, mixing, and compiled schedules.
+
+Pure-function tests (no devices needed): permutation property, regularity,
+rotation periodicity, column-stochasticity, involution of bilat pairings —
+the properties push-sum correctness rests on (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.topology import (
+    GRAPH_TOPOLOGIES,
+    DynamicBipartiteExponentialGraph,
+    DynamicBipartiteLinearGraph,
+    DynamicDirectedExponentialGraph,
+    DynamicDirectedLinearGraph,
+    NPeerDynamicDirectedExponentialGraph,
+    RingGraph,
+    UniformMixing,
+    build_pairing_schedule,
+    build_schedule,
+)
+
+ALL_GRAPHS = [
+    DynamicDirectedExponentialGraph,
+    NPeerDynamicDirectedExponentialGraph,
+    DynamicBipartiteExponentialGraph,
+    DynamicDirectedLinearGraph,
+    DynamicBipartiteLinearGraph,
+    RingGraph,
+]
+
+
+@pytest.mark.parametrize("cls", ALL_GRAPHS)
+@pytest.mark.parametrize("world", [2, 4, 8, 16])
+def test_phase_rows_are_permutations(cls, world):
+    g = cls(world_size=world, peers_per_itr=1)
+    perms = g.all_phase_permutations
+    assert perms.shape == (g.num_phases, 1, world)
+    for p in range(g.num_phases):
+        assert sorted(perms[p, 0].tolist()) == list(range(world))
+        # no self-sends
+        assert not np.any(perms[p, 0] == np.arange(world))
+
+
+@pytest.mark.parametrize("world,ppi", [(8, 2), (16, 2), (16, 3), (8, 1)])
+def test_npdde_multi_peer(world, ppi):
+    g = NPeerDynamicDirectedExponentialGraph(world_size=world,
+                                             peers_per_itr=ppi)
+    perms = g.all_phase_permutations
+    assert perms.shape[1] == ppi
+    for p in range(g.num_phases):
+        dsts = set()
+        for i in range(ppi):
+            row = perms[p, i].tolist()
+            assert sorted(row) == list(range(world))
+            # distinct peers across sub-rounds for any given src
+            for src in range(world):
+                assert (i, row[src]) not in dsts
+                dsts.add((i, row[src]))
+
+
+@pytest.mark.parametrize("cls", ALL_GRAPHS)
+def test_in_out_degree_regular(cls):
+    world = 8
+    g = cls(world_size=world, peers_per_itr=1)
+    assert g.is_regular_graph()
+    for phase in range(g.num_phases):
+        for r in range(world):
+            assert len(g.out_peers(r, phase)) == 1
+            assert len(g.in_peers(r, phase)) == 1
+
+
+def test_rotation_periodicity():
+    g = DynamicDirectedExponentialGraph(world_size=8, peers_per_itr=1)
+    # phone book: +-1, +-2, +-4 → 6 entries (4 == -4 mod 8 dedup → 5 entries)
+    L = g.phone_book_len
+    assert g.num_phases == L
+    for r in range(8):
+        assert g.out_peers(r, 0) == g.out_peers(r, g.num_phases)
+
+
+def test_static_ring_never_rotates():
+    g = RingGraph(world_size=8, peers_per_itr=1)
+    assert g.num_phases == 1
+    for phase in range(4):
+        assert g.out_peers(3, phase) == (4,)
+        assert g.in_peers(3, phase) == (2,)
+
+
+def test_dde_peers_match_reference_structure():
+    # world 8, rank 0: forward/backward powers of two: 1, 7, 2, 6, 4
+    g = DynamicDirectedExponentialGraph(world_size=8)
+    assert g.phone_book[0] == [1, 7, 2, 6, 4]
+
+
+def test_npdde_peers_match_reference_structure():
+    # world 16 ppi 1: distances 2^i → 1, 2, 4, 8
+    g = NPeerDynamicDirectedExponentialGraph(world_size=16, peers_per_itr=1)
+    assert g.phone_book[0] == [1, 2, 4, 8]
+    # world 16 ppi 2: j*(3^i) for j in {1,2}, i in {0,1,2} → 1,2,3,6,9,18%16=2?
+    g2 = NPeerDynamicDirectedExponentialGraph(world_size=16, peers_per_itr=2)
+    assert g2.phone_book[0][:4] == [1, 2, 3, 6]
+
+
+def test_bipartite_active_passive_split():
+    g = DynamicBipartiteExponentialGraph(world_size=8)
+    for r in range(8):
+        assert g.is_passive(r) == (r % 2 == 0)
+        for phase in range(g.num_phases):
+            for peer in g.out_peers(r, phase):
+                assert g.is_passive(peer) != g.is_passive(r)
+
+
+@pytest.mark.parametrize("cls", ALL_GRAPHS)
+@pytest.mark.parametrize("world", [4, 8])
+def test_schedule_column_stochastic(cls, world):
+    g = cls(world_size=world, peers_per_itr=1)
+    sched = build_schedule(g, UniformMixing())
+    for p in range(sched.num_phases):
+        W = sched.mixing_matrix(p)
+        np.testing.assert_allclose(W.sum(axis=0), np.ones(world), atol=1e-12)
+
+
+@pytest.mark.parametrize("cls", ALL_GRAPHS)
+def test_schedule_doubly_stochastic_when_regular(cls):
+    # uniform mixing on a regular graph → rows also sum to 1
+    g = cls(world_size=8, peers_per_itr=1)
+    sched = build_schedule(g, UniformMixing())
+    assert sched.regular
+    for p in range(sched.num_phases):
+        W = sched.mixing_matrix(p)
+        np.testing.assert_allclose(W.sum(axis=1), np.ones(8), atol=1e-12)
+
+
+def test_mixing_matrix_products_converge_to_consensus():
+    # repeated application of the phase-cycled mixing matrices must drive
+    # any vector to its mean (ergodicity of the time-varying graph)
+    g = NPeerDynamicDirectedExponentialGraph(world_size=8, peers_per_itr=1)
+    sched = build_schedule(g)
+    x = np.random.default_rng(0).normal(size=(8,))
+    mean = x.mean()
+    for step in range(60):
+        x = sched.mixing_matrix(step) @ x
+    np.testing.assert_allclose(x, np.full(8, mean), atol=1e-9)
+
+
+@pytest.mark.parametrize("cls", [DynamicBipartiteExponentialGraph,
+                                 DynamicBipartiteLinearGraph, RingGraph,
+                                 DynamicDirectedExponentialGraph])
+@pytest.mark.parametrize("world", [4, 8, 16])
+def test_pairing_schedule_involution(cls, world):
+    g = cls(world_size=world)
+    pairing = build_pairing_schedule(g)
+    n_phases, n = pairing.shape
+    assert n == world
+    for p in range(n_phases):
+        row = pairing[p]
+        assert np.array_equal(row[row], np.arange(world))
+        assert not np.any(row == np.arange(world))  # nobody self-paired
+
+
+def test_pairing_covers_multiple_partners():
+    g = DynamicBipartiteExponentialGraph(world_size=8)
+    pairing = build_pairing_schedule(g)
+    partners_of_1 = set(pairing[:, 1].tolist())
+    assert len(partners_of_1) > 1
+
+
+def test_registry_ids_match_reference():
+    # gossip_sgd.py:54-67
+    assert GRAPH_TOPOLOGIES[0] is DynamicDirectedExponentialGraph
+    assert GRAPH_TOPOLOGIES[1] is DynamicBipartiteExponentialGraph
+    assert GRAPH_TOPOLOGIES[2] is DynamicDirectedLinearGraph
+    assert GRAPH_TOPOLOGIES[3] is DynamicBipartiteLinearGraph
+    assert GRAPH_TOPOLOGIES[4] is RingGraph
+    assert GRAPH_TOPOLOGIES[5] is NPeerDynamicDirectedExponentialGraph
+    assert GRAPH_TOPOLOGIES[-1] is None
+
+
+def test_world_size_one_is_trivial():
+    g = NPeerDynamicDirectedExponentialGraph(world_size=1)
+    assert g.out_peers(0, 0) == ()
+    sched = build_schedule(g)
+    np.testing.assert_allclose(sched.mixing_matrix(0), np.ones((1, 1)))
