@@ -1,0 +1,28 @@
+"""Decentralized data-parallel training algorithms."""
+
+from .api import GossipAlgorithm, GossipState
+from .algorithms import (
+    AllReduce,
+    BilateralGossip,
+    PushPullGossip,
+    PushSumGossip,
+    adpsgd,
+    all_reduce,
+    dpsgd,
+    osgp,
+    sgp,
+)
+
+__all__ = [
+    "GossipAlgorithm",
+    "GossipState",
+    "AllReduce",
+    "PushSumGossip",
+    "PushPullGossip",
+    "BilateralGossip",
+    "all_reduce",
+    "sgp",
+    "osgp",
+    "dpsgd",
+    "adpsgd",
+]

@@ -1,0 +1,214 @@
+"""The five training algorithms: AR, SGP, OSGP, D-PSGD, AD-PSGD.
+
+Selection matrix (mirrors the reference CLI semantics, gossip_sgd.py:179-190):
+
+| reference flags                    | here                          |
+|------------------------------------|-------------------------------|
+| ``--all_reduce True``              | :func:`all_reduce`            |
+| ``--push_sum True``                | :func:`sgp` (overlap=False)   |
+| ``--push_sum True --overlap True`` | :func:`sgp` (overlap=True)    |
+| ``--push_sum False``               | :func:`dpsgd`                 |
+| ``gossip_sgd_adpsgd.py``           | :func:`adpsgd`                |
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import collectives
+from ..parallel.collectives import as_scalar
+from ..topology.schedule import GossipSchedule
+from .api import GossipAlgorithm, GossipState, Params
+
+__all__ = ["all_reduce", "sgp", "osgp", "dpsgd", "adpsgd",
+           "AllReduce", "PushSumGossip", "PushPullGossip", "BilateralGossip"]
+
+
+class AllReduce(GossipAlgorithm):
+    """Exact AllReduce-SGD baseline (≙ DistributedDataParallel,
+    gossip_sgd.py:179-180): average gradients with ``psum`` every step."""
+
+    name = "ar"
+
+    def __init__(self, axis_name: str):
+        self.axis_name = axis_name
+
+    def reduce_grads(self, grads: Params) -> Params:
+        return collectives.allreduce_mean(grads, self.axis_name)
+
+
+class PushSumGossip(GossipAlgorithm):
+    """Stochastic Gradient Push — synchronous or overlap (SGP / OSGP).
+
+    Synchronous (overlap=False, ≙ ``GossipDataParallel(push_sum=True,
+    overlap=False)``): after the optimizer step, run one complete push-sum
+    round — parameters and push-sum weight mixed jointly
+    (distributed.py:389-434 + gossiper.py:176-219 collapsed into one
+    collective).
+
+    Overlap (overlap=True, ≙ OSGP, distributed.py:571-588): ``post_step``
+    keeps only the local share ``lo·x`` and stores the peers' contributions
+    in ``state.in_flight``; ``pre_step`` of the *next* iteration adds them —
+    the same one-step staleness the reference gets from its gossip thread,
+    except the "thread" is XLA's collective scheduler overlapping the
+    ppermute with backprop compute.
+    """
+
+    name = "sgp"
+
+    def __init__(self, schedule: GossipSchedule, axis_name: str,
+                 overlap: bool = False, track_weight: bool = True):
+        self.schedule = schedule
+        self.axis_name = axis_name
+        self.overlap = overlap
+        # push-pull (D-PSGD) reuses this machinery with no ps-weight
+        self.track_weight = track_weight
+
+    # -- helpers -----------------------------------------------------------
+
+    def _zeros_like_params(self, params: Params):
+        return jax.tree.map(jnp.zeros_like, params)
+
+    def _mix(self, params, ps_weight, phase):
+        if self.track_weight:
+            return collectives.mix_push_sum(
+                params, ps_weight, phase, self.schedule, self.axis_name)
+        return (collectives.mix_push_pull(
+            params, phase, self.schedule, self.axis_name), ps_weight)
+
+    def _split_round(self, params, ps_weight, phase):
+        """One round split into (local share, incoming share).
+
+        local = lo·x; incoming = Σ_i w_i·ppermute(x) — their sum is exactly
+        the synchronous round, so overlap mode differs from sync only in
+        *when* the incoming share is applied.
+        """
+        tree = (params, ps_weight)
+        mixed = collectives.gossip_round(
+            tree, phase, self.schedule, self.axis_name)
+        # local share is a cheap rescale; recover incoming by subtraction
+        # would lose precision — instead compute local share directly and
+        # subtract from the mixed total.
+        num_phases = self.schedule.num_phases
+        lo_table = jnp.asarray(self.schedule.self_weight, jnp.float32)
+        lo = lo_table[as_scalar(phase) % num_phases]
+        local = jax.tree.map(lambda a: a * lo.astype(a.dtype), tree)
+        incoming = jax.tree.map(jnp.subtract, mixed, local)
+        return local, incoming
+
+    # -- algorithm slots ---------------------------------------------------
+
+    def init(self, params: Params) -> GossipState:
+        state = GossipState(phase=jnp.int32(0), ps_weight=jnp.float32(1.0))
+        if self.overlap:
+            in_flight = (self._zeros_like_params(params), jnp.float32(0.0))
+            state = state.replace(in_flight=in_flight)
+        return state
+
+    def pre_step(self, params, state):
+        if not self.overlap:
+            return params, state
+        # consume the round launched last step (≙ _query_gossip_queue,
+        # distributed.py:336-387: p += r; ps_weight += gossip_ps_weight)
+        in_params, in_w = state.in_flight
+        params = jax.tree.map(jnp.add, params, in_params)
+        ps_weight = state.ps_weight + jnp.reshape(in_w, jnp.shape(state.ps_weight))
+        return params, state.replace(ps_weight=ps_weight)
+
+    def eval_params(self, params, state):
+        if not self.track_weight:
+            return params
+        w = as_scalar(state.ps_weight)
+        return jax.tree.map(lambda p: p / w.astype(p.dtype), params)
+
+    def post_step(self, params, state):
+        phase = state.phase
+        if not self.overlap:
+            params, ps_weight = self._mix(params, state.ps_weight, phase)
+            ps_weight = jnp.reshape(jnp.asarray(ps_weight, jnp.float32),
+                                    jnp.shape(state.ps_weight))
+            return params, state.replace(phase=phase + 1,
+                                         ps_weight=ps_weight)
+        # overlap: keep local share now, stash incoming for next pre_step
+        (local_p, local_w), incoming = self._split_round(
+            params, state.ps_weight, phase)
+        local_w = jnp.reshape(jnp.asarray(local_w, jnp.float32),
+                              jnp.shape(state.ps_weight))
+        return local_p, state.replace(phase=phase + 1,
+                                      ps_weight=local_w,
+                                      in_flight=incoming)
+
+
+class PushPullGossip(PushSumGossip):
+    """D-PSGD: doubly-stochastic gossip
+    (≙ ``GossipDataParallel(push_sum=False)`` → ``PushPull.mix``,
+    gossiper.py:222-275).
+
+    Synchronous mode needs no push-sum weight: a complete doubly-stochastic
+    round preserves the mean directly.  Overlap mode *must* track it — the
+    parameters are scaled by ``lo`` between launching a round and consuming
+    it, and the de-bias division is what keeps gradients evaluated at the
+    right point (the reference's ps-weight machinery likewise stays active
+    for PushPull, gossiper.py:160-169 with distributed.py:298-314).
+    """
+
+    name = "dpsgd"
+
+    def __init__(self, schedule: GossipSchedule, axis_name: str,
+                 overlap: bool = False):
+        if not schedule.regular:
+            raise ValueError("D-PSGD requires a regular schedule "
+                             "(doubly-stochastic mixing)")
+        super().__init__(schedule, axis_name, overlap=overlap,
+                         track_weight=overlap)
+
+
+class BilateralGossip(GossipAlgorithm):
+    """AD-PSGD in its synchronous perfect-matching formulation.
+
+    The reference runs bilateral averaging in a separate OS process with its
+    own optimizer, shipping gradients through shared memory
+    (ad_psgd.py:120-133, 252-366) — host-side asynchrony that cannot (and
+    should not) live inside one SPMD program.  The TPU-native counterpart:
+    every step, each rank averages parameters with one rotating partner,
+    ``x ← (x + x_partner)/2`` (≙ ad_psgd.py:358-361), with the matching
+    schedule derived from the same communication graph.  See SURVEY.md §7
+    "Hard parts" #4 for the staleness-distribution caveat.
+    """
+
+    name = "adpsgd"
+
+    def __init__(self, pairing: np.ndarray, axis_name: str):
+        self.pairing = pairing
+        self.axis_name = axis_name
+
+    def post_step(self, params, state):
+        params = collectives.mix_bilat(
+            params, state.phase, self.pairing, self.axis_name)
+        return params, state.replace(phase=state.phase + 1)
+
+
+# -- factory helpers matching the reference's flag surface -------------------
+
+def all_reduce(axis_name: str) -> AllReduce:
+    return AllReduce(axis_name)
+
+
+def sgp(schedule: GossipSchedule, axis_name: str,
+        overlap: bool = False) -> PushSumGossip:
+    return PushSumGossip(schedule, axis_name, overlap=overlap)
+
+
+def osgp(schedule: GossipSchedule, axis_name: str) -> PushSumGossip:
+    return PushSumGossip(schedule, axis_name, overlap=True)
+
+
+def dpsgd(schedule: GossipSchedule, axis_name: str,
+          overlap: bool = False) -> PushPullGossip:
+    return PushPullGossip(schedule, axis_name, overlap=overlap)
+
+
+def adpsgd(pairing: np.ndarray, axis_name: str) -> BilateralGossip:
+    return BilateralGossip(pairing, axis_name)

@@ -1,0 +1,84 @@
+"""Decentralized-averaging algorithms as pure state transforms.
+
+The reference wraps models in stateful ``nn.Module`` subclasses whose behavior
+is spread across forward-pre hooks, backward hooks, a background gossip
+thread, and bias/de-bias flags (``GossipDataParallel``, distributed.py:39-589;
+``BilatGossipDataParallel``, ad_psgd.py:36-418).  Here each algorithm is four
+pure functions over an explicit :class:`GossipState`, slotted into the train
+step at fixed points:
+
+```
+params, gstate = alg.pre_step(params, gstate)        # consume in-flight gossip
+z              = alg.eval_params(params, gstate)     # de-biased params for fwd
+grads          = alg.reduce_grads(grads)             # exact averaging (AR/local)
+params, gstate = alg.post_step(params, gstate)       # gossip round / launch
+```
+
+This is the hook dance of distributed.py:512-589 made explicit: ``pre_step``
+≙ the forward-pre hook's ``_query_gossip_queue`` (+ ``transfer_params`` in
+overlap mode), ``eval_params`` ≙ ``unbias`` (distributed.py:307-314),
+``reduce_grads`` ≙ the backward hook's intra-node reduction
+(distributed.py:520-562), ``post_step`` ≙ ``transfer_params`` + the gossip
+thread's ``mix`` (distributed.py:389-434, 459-510).  The ``is_ps_numerator``
+flag, heartbeat timeouts, poison values, and lock protocol all disappear:
+state is explicit and the collective is part of the compiled step.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import flax.struct
+import jax.numpy as jnp
+
+Params = tp.Any  # arbitrary pytree of arrays
+
+
+@flax.struct.dataclass
+class GossipState:
+    """Per-rank algorithm state carried through the train step.
+
+    Attributes:
+      phase: int32 rotation counter — replaces ``GraphManager``'s mutable
+        ``_group_indices`` (graph_manager.py:128-133).
+      ps_weight: float32 scalar push-sum weight (distributed.py:134-136).
+        Stays exactly 1.0 for synchronous regular mixing; deviates between
+        launch and consume in overlap mode.
+      in_flight: pytree of pending peer contributions (overlap mode), the
+        compiled analogue of the gossip thread's receive buffer
+        (distributed.py:149-155); ``None`` for synchronous algorithms.
+    """
+
+    phase: jnp.ndarray
+    ps_weight: jnp.ndarray
+    in_flight: tp.Any = None
+
+
+class GossipAlgorithm:
+    """Base algorithm: exact data parallelism (no gossip).
+
+    Subclasses override the four slots.  The base class doubles as the
+    AllReduce baseline when constructed via :func:`~.algorithms.all_reduce`.
+    """
+
+    name: str = "base"
+
+    def init(self, params: Params) -> GossipState:
+        del params
+        return GossipState(phase=jnp.int32(0), ps_weight=jnp.float32(1.0))
+
+    def pre_step(self, params: Params, state: GossipState
+                 ) -> tuple[Params, GossipState]:
+        return params, state
+
+    def eval_params(self, params: Params, state: GossipState) -> Params:
+        """De-biased parameter estimate used for forward/eval
+        (≙ ``unbias``, distributed.py:307-314)."""
+        return params
+
+    def reduce_grads(self, grads: Params) -> Params:
+        return grads
+
+    def post_step(self, params: Params, state: GossipState
+                  ) -> tuple[Params, GossipState]:
+        return params, state

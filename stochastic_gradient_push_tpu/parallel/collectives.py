@@ -32,6 +32,7 @@ from jax import lax
 from ..topology.schedule import GossipSchedule
 
 __all__ = [
+    "as_scalar",
     "gossip_round",
     "mix_push_sum",
     "mix_push_pull",
@@ -44,6 +45,16 @@ __all__ = [
 def _perm_pairs(dests: np.ndarray) -> list[tuple[int, int]]:
     """ppermute (source, destination) pairs from a destination table."""
     return [(int(src), int(dst)) for src, dst in enumerate(dests)]
+
+
+def as_scalar(x):
+    """Normalize a traced state scalar to shape ().
+
+    Per-rank state scalars arrive shaped ``(1,)`` when sharded over the
+    gossip axis of a mesh (one element per rank); every consumer that
+    indexes, switches, or broadcasts on them goes through this.
+    """
+    return jnp.reshape(x, ())
 
 
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str):
@@ -87,7 +98,7 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str):
         return _round_fn(schedule, 0, axis_name)(tree)
     branches = [_round_fn(schedule, p, axis_name)
                 for p in range(schedule.num_phases)]
-    return lax.switch(phase % schedule.num_phases, branches, tree)
+    return lax.switch(as_scalar(phase) % schedule.num_phases, branches, tree)
 
 
 def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
@@ -151,7 +162,7 @@ def mix_bilat(params, phase, pairing: np.ndarray, axis_name: str):
 
     if num_phases == 1:
         return branch(0)(params)
-    return lax.switch(phase % num_phases,
+    return lax.switch(as_scalar(phase) % num_phases,
                       [branch(p) for p in range(num_phases)], params)
 
 
