@@ -1,0 +1,15 @@
+"""Input pipelines."""
+
+from .pipeline import (
+    DistributedSampler,
+    ShardedLoader,
+    imagefolder_arrays,
+    synthetic_classification,
+)
+
+__all__ = [
+    "DistributedSampler",
+    "ShardedLoader",
+    "synthetic_classification",
+    "imagefolder_arrays",
+]

@@ -1,0 +1,167 @@
+"""Input pipeline: sharded samplers and loaders for decentralized DP.
+
+Mirrors the reference's data plumbing (gossip_sgd.py:539-583):
+
+* :class:`DistributedSampler` — same contract as
+  ``torch.utils.data.distributed.DistributedSampler``: per-epoch seeded
+  shuffle (``set_epoch``, seeded ``epoch + seed*90`` by the caller,
+  gossip_sgd.py:289), padding to a multiple of world size, strided shard
+  per rank.
+* :class:`ShardedLoader` — batches every rank's shard and stacks them into
+  one global ``(world, per_rank_batch, ...)`` array, the layout the sharded
+  train step consumes.  ``fast_forward`` reproduces the reference's
+  checkpoint-resume sampler spoofing (gossip_sgd.py:356-364) without
+  loading and discarding data.
+* :func:`synthetic_classification` — a deterministic, learnable synthetic
+  dataset (class-dependent means + noise) used by smoke tests and
+  benchmarks; the reference has no equivalent (its only testing affordance
+  is early-exit, SURVEY.md §4).
+* :func:`imagefolder_arrays` — ImageNet-style directory loading via
+  torchvision when available (CPU decode), for accuracy-parity runs.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import numpy as np
+
+__all__ = ["DistributedSampler", "ShardedLoader",
+           "synthetic_classification", "imagefolder_arrays"]
+
+
+class DistributedSampler:
+    """Deterministic per-rank index sampler.
+
+    Same semantics as torch's ``DistributedSampler(shuffle=True)``: shuffle
+    ``range(n)`` with ``seed = epoch`` (callers pass ``epoch + seed*90``
+    like gossip_sgd.py:289), pad by wrapping so every rank gets
+    ``ceil(n / world)`` samples, then stride by rank.
+    """
+
+    def __init__(self, dataset_len: int, world_size: int, rank: int | None = None):
+        if dataset_len < 1:
+            raise ValueError("dataset_len must be >= 1")
+        self.n = int(dataset_len)
+        self.world_size = int(world_size)
+        self.rank = rank
+        self.epoch = 0
+        self.num_samples = -(-self.n // self.world_size)  # ceil
+        self.total_size = self.num_samples * self.world_size
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def indices_for_rank(self, rank: int | None = None) -> np.ndarray:
+        rank = self.rank if rank is None else rank
+        if rank is None:
+            raise ValueError("no rank given and none set at construction")
+        g = np.random.default_rng(self.epoch)
+        idx = g.permutation(self.n)
+        if self.total_size > self.n:
+            idx = np.concatenate([idx, idx[: self.total_size - self.n]])
+        return idx[rank::self.world_size]
+
+    def all_indices(self) -> np.ndarray:
+        """(world_size, num_samples) index table for stacked loading."""
+        return np.stack([self.indices_for_rank(r)
+                         for r in range(self.world_size)])
+
+
+class ShardedLoader:
+    """Iterates global batches stacked over the world dimension.
+
+    Yields ``(images, labels)`` with shapes ``(world, batch, ...)`` /
+    ``(world, batch)`` — ready for a ``P('gossip')``-sharded train step.
+    Incomplete trailing batches are dropped (torch drops them per-rank when
+    ``drop_last``; with the stacked layout a ragged last batch would change
+    shapes and trigger recompilation, so dropping is the XLA-friendly
+    default).
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, sampler: DistributedSampler):
+        if len(images) != len(labels):
+            raise ValueError("images and labels length mismatch")
+        self.images = images
+        self.labels = labels
+        self.batch_size = int(batch_size)
+        self.sampler = sampler
+        self.start_itr = 0
+
+    def __len__(self) -> int:
+        return self.sampler.num_samples // self.batch_size
+
+    def fast_forward(self, itr: int) -> None:
+        """Resume mid-epoch: skip the first ``itr`` batches
+        (≙ the sampler spoof at gossip_sgd.py:356-364)."""
+        self.start_itr = int(itr)
+
+    def __iter__(self):
+        table = self.sampler.all_indices()
+        n_batches = len(self)
+        for b in range(self.start_itr, n_batches):
+            sel = table[:, b * self.batch_size:(b + 1) * self.batch_size]
+            yield self.images[sel], self.labels[sel]
+        self.start_itr = 0
+
+
+def synthetic_classification(n: int, num_classes: int = 10,
+                             image_size: int = 16, channels: int = 3,
+                             seed: int = 0,
+                             dtype=np.float32) -> tuple[np.ndarray, np.ndarray]:
+    """Learnable synthetic image classification data.
+
+    Each class has a fixed random mean image; samples are mean + noise, so a
+    small model can fit them and smoke tests can assert loss decrease.
+    """
+    g = np.random.default_rng(seed)
+    means = g.normal(scale=1.0,
+                     size=(num_classes, image_size, image_size, channels))
+    labels = g.integers(0, num_classes, size=(n,))
+    images = means[labels] + g.normal(
+        scale=0.5, size=(n, image_size, image_size, channels))
+    return images.astype(dtype), labels.astype(np.int32)
+
+
+def imagefolder_arrays(root: str, split: str, image_size: int = 224,
+                       train: bool = True,
+                       limit: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Load an ImageNet-style folder through torchvision (CPU decode).
+
+    Transform parity with gossip_sgd.py:546-581: train = RandomResizedCrop +
+    horizontal flip; val = Resize(256) + CenterCrop; both normalized with
+    the ImageNet mean/std.  Returns NHWC float32 arrays.
+
+    This eager loader is intended for validation sets and accuracy-parity
+    runs; large-scale input pipelines should stream per-batch instead.
+    """
+    import torch
+    import torchvision.datasets as datasets
+    import torchvision.transforms as transforms
+
+    normalize = transforms.Normalize(mean=[0.485, 0.456, 0.406],
+                                     std=[0.229, 0.224, 0.225])
+    if train:
+        tf = transforms.Compose([
+            transforms.RandomResizedCrop(image_size),
+            transforms.RandomHorizontalFlip(),
+            transforms.ToTensor(), normalize])
+    else:
+        tf = transforms.Compose([
+            transforms.Resize(int(image_size * 256 / 224)),
+            transforms.CenterCrop(image_size),
+            transforms.ToTensor(), normalize])
+    ds = datasets.ImageFolder(f"{root}/{split}", tf)
+    if limit is not None and limit < len(ds):
+        # ImageFolder is ordered by class; subsample uniformly so a limited
+        # load still covers all classes instead of the first few
+        sel = np.linspace(0, len(ds) - 1, limit).astype(np.int64)
+        ds = torch.utils.data.Subset(ds, sel.tolist())
+    loader = torch.utils.data.DataLoader(ds, batch_size=256, shuffle=False)
+    images, labels = [], []
+    for x, y in loader:
+        images.append(x.numpy().transpose(0, 2, 3, 1))  # NCHW → NHWC
+        labels.append(y.numpy())
+    return (np.concatenate(images).astype(np.float32),
+            np.concatenate(labels).astype(np.int32))

@@ -1,0 +1,24 @@
+"""Model zoo: ResNet family (flagship: resnet50) and small test models."""
+
+from .resnet import (
+    RESNETS,
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+from .small import TinyCNN, TinyMLP
+
+__all__ = [
+    "ResNet",
+    "RESNETS",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "TinyCNN",
+    "TinyMLP",
+]

@@ -1,0 +1,50 @@
+"""Small models for tests and smoke runs (SURVEY.md §7 minimum slice)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyCNN(nn.Module):
+    """A few conv blocks + dense head; CIFAR-sized inputs.
+
+    Used by the end-to-end smoke tests the reference enables via
+    ``--num_iterations_per_training_epoch`` (gossip_sgd.py:83-88) but never
+    ships a model for.
+    """
+
+    num_classes: int = 10
+    width: int = 16
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        for i in range(3):
+            x = nn.Conv(self.width * 2 ** i, (3, 3), use_bias=False,
+                        dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=nn.initializers.normal(stddev=0.01))(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+class TinyMLP(nn.Module):
+    """Minimal MLP for the fastest possible distributed smoke tests."""
+
+    num_classes: int = 10
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.width)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x

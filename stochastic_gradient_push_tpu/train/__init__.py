@@ -1,0 +1,30 @@
+"""Training harness: state, steps, schedules, metrics."""
+
+from .lr import LRSchedule, ppi_at_epoch
+from .metrics import accuracy_topk, kl_div_loss, one_hot
+from .state import TrainState, init_train_state, sgd
+from .step import (
+    build_eval_step,
+    build_train_step,
+    replicate_state,
+    shard_eval_step,
+    shard_train_step,
+    unreplicate,
+)
+
+__all__ = [
+    "LRSchedule",
+    "ppi_at_epoch",
+    "accuracy_topk",
+    "kl_div_loss",
+    "one_hot",
+    "TrainState",
+    "init_train_state",
+    "sgd",
+    "build_train_step",
+    "build_eval_step",
+    "shard_train_step",
+    "shard_eval_step",
+    "replicate_state",
+    "unreplicate",
+]

@@ -1,0 +1,89 @@
+"""Learning-rate and peers-per-iteration schedules.
+
+Reproduces the reference recipe exactly (gossip_sgd.py:508-536):
+
+1. target_lr = ref_lr · global_batch / 256 ("ImageNet in 1hr" scaling)
+2. optional linear warmup from ref_lr to target_lr over the first 5 epochs
+3. piecewise exponential decay: lr ·= factor at each schedule epoch
+
+plus the peers-per-iteration epoch schedule (gossip_sgd.py:497-505,
+636-649).  The LR function is pure and jit-compatible (piecewise via
+``jnp.where``), evaluated *every* step — the reference only refreshes every
+100 iterations (gossip_sgd.py:386-388) as a host-side optimization that a
+compiled schedule gets for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LRSchedule", "ppi_at_epoch"]
+
+WARMUP_EPOCHS = 5
+
+
+class LRSchedule:
+    """Callable ``(epoch, itr, itr_per_epoch) -> lr`` matching
+    ``update_learning_rate`` (gossip_sgd.py:508-536).
+
+    Args:
+      ref_lr: reference LR for a 256-sample global batch (``--lr``).
+      batch_size: per-rank batch size.
+      world_size: number of ranks.
+      decay_schedule: {epoch: factor} piecewise decays
+        (default {30: .1, 60: .1, 80: .1}, gossip_sgd.py:108-109).
+      warmup: linear warmup over the first 5 epochs (``--warmup``).
+      scale: extra LR scale (the reference's ``scale`` argument).
+    """
+
+    def __init__(self, ref_lr: float, batch_size: int, world_size: int,
+                 decay_schedule: dict[int, float] | None = None,
+                 warmup: bool = False, scale: float = 1.0):
+        if decay_schedule is None:
+            decay_schedule = {30: 0.1, 60: 0.1, 80: 0.1}
+        self.ref_lr = float(ref_lr)
+        self.target_lr = float(
+            ref_lr * batch_size * scale * world_size / 256.0)
+        self.decay_schedule = dict(sorted(decay_schedule.items()))
+        self.warmup = bool(warmup)
+
+    def __call__(self, epoch, itr, itr_per_epoch):
+        """LR for a (possibly traced) position in training."""
+        epoch = jnp.asarray(epoch, jnp.float32)
+        itr = jnp.asarray(itr, jnp.float32)
+        itr_per_epoch = jnp.asarray(itr_per_epoch, jnp.float32)
+
+        # post-warmup piecewise-decayed LR
+        lr = jnp.float32(self.target_lr)
+        for e, factor in self.decay_schedule.items():
+            lr = jnp.where(epoch >= e, lr * factor, lr)
+
+        if self.warmup:
+            if self.target_lr <= self.ref_lr:
+                warm = jnp.float32(self.target_lr)
+            else:
+                count = epoch * itr_per_epoch + itr + 1.0
+                incr = (self.target_lr - self.ref_lr) * (
+                    count / (WARMUP_EPOCHS * itr_per_epoch))
+                warm = self.ref_lr + incr
+            lr = jnp.where(epoch < WARMUP_EPOCHS, warm, lr)
+        return lr
+
+
+def ppi_at_epoch(ppi_schedule: dict[int, int], epoch: int) -> int:
+    """Peers-per-itr in effect at ``epoch`` (≙ gossip_sgd.py:497-505).
+
+    Host-side (python int): changing ppi changes permutation-table shapes,
+    so each value selects a distinct compiled step (SURVEY.md §7 hard
+    part #2).
+    """
+    ppi, e_max = None, -1
+    for e, v in ppi_schedule.items():
+        if e_max <= e <= epoch:
+            e_max = e
+            ppi = v
+    if ppi is None:
+        raise ValueError(
+            f"ppi_schedule {ppi_schedule} has no entry for epoch {epoch}; "
+            "an epoch-0 entry is required")
+    return ppi

@@ -1,0 +1,49 @@
+"""Loss and accuracy metrics matching the reference training harness.
+
+The reference trains with ``KLDivLoss(log_softmax(logits), one_hot)`` with
+batchmean reduction (gossip_sgd.py:192-198) — for one-hot targets this equals
+cross-entropy, but the formulation here mirrors the reference exactly so
+soft targets (label smoothing, distillation) behave identically too.
+Accuracy is top-k precision (gossip_sgd.py:474-488).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["kl_div_loss", "one_hot", "accuracy_topk"]
+
+
+def one_hot(labels: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    """One-hot targets (≙ the scatter_ at gossip_sgd.py:372-373)."""
+    return jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+
+
+def kl_div_loss(logits: jnp.ndarray, kl_target: jnp.ndarray) -> jnp.ndarray:
+    """``KLDivLoss(reduction='batchmean')(log_softmax(logits), target)``.
+
+    KL(target || softmax(logits)) summed over classes, averaged over the
+    batch.  Terms with target == 0 contribute 0 (matching torch, which
+    defines 0·log 0 = 0).
+    """
+    log_probs = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+    target = jnp.asarray(kl_target, jnp.float32)
+    entropy_term = jnp.where(target > 0, target * jnp.log(
+        jnp.where(target > 0, target, 1.0)), 0.0)
+    pointwise = entropy_term - target * log_probs
+    return jnp.sum(pointwise) / logits.shape[0]
+
+
+def accuracy_topk(logits: jnp.ndarray, labels: jnp.ndarray,
+                  topk=(1, 5)) -> tuple[jnp.ndarray, ...]:
+    """Precision@k in percent (≙ gossip_sgd.py:474-488)."""
+    maxk = max(topk)
+    # top-k indices by logit, descending
+    idx = jnp.argsort(logits, axis=-1)[:, ::-1][:, :maxk]
+    correct = idx == labels[:, None]
+    res = []
+    for k in topk:
+        res.append(100.0 * jnp.mean(
+            jnp.any(correct[:, :k], axis=-1).astype(jnp.float32)))
+    return tuple(res)

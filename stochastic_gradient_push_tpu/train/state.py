@@ -1,0 +1,84 @@
+"""Explicit train state and a torch-compatible SGD transform.
+
+The reference's training state is scattered across the ``nn.Module`` wrapper
+(params, buffers, ps_weight, is_ps_numerator flags), ``torch.optim.SGD``
+internals, and host variables (distributed.py:134-155, gossip_sgd.py:200-217).
+Here it is one pytree, so checkpointing, sharding, and the gossip algebra all
+operate on explicit values.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..algorithms.api import GossipState
+
+__all__ = ["TrainState", "sgd", "init_train_state"]
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Complete per-rank training state.
+
+    Attributes:
+      step: global iteration counter.
+      params: model parameters (the push-sum *numerator* for SGP-family
+        algorithms — the optimizer steps these directly, exactly as the
+        reference's SGD steps biased params, distributed.py:298-305).
+      batch_stats: BatchNorm running statistics.  Never gossiped — the
+        reference keeps BN buffers rank-local too (distributed.py:269-276;
+        SURVEY.md §7 hard part #5).
+      opt_state: SGD momentum buffers.
+      gossip: :class:`GossipState` (phase, ps_weight, in-flight buffer).
+    """
+
+    step: jnp.ndarray
+    params: tp.Any
+    batch_stats: tp.Any
+    opt_state: tp.Any
+    gossip: GossipState
+
+
+def sgd(momentum: float = 0.9, weight_decay: float = 1e-4,
+        nesterov: bool = False) -> optax.GradientTransformation:
+    """SGD with the exact ``torch.optim.SGD`` update rule the reference uses
+    (gossip_sgd.py:200-204):
+
+        d   = grad + wd * p
+        buf = momentum * buf + d
+        d   = d + momentum * buf   (nesterov)  |  buf  (otherwise)
+        p  -= lr * d
+
+    Note the reference applies weight decay to *all* parameters including
+    BatchNorm scales (it passes one param group).  The learning rate is
+    applied by the caller so schedules stay inside the jitted step.
+    """
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.trace(decay=momentum, nesterov=nesterov),
+    )
+
+
+def init_train_state(model, rng: jax.Array, sample_input: jnp.ndarray,
+                     tx: optax.GradientTransformation,
+                     algorithm) -> TrainState:
+    """Single-rank state init.
+
+    All ranks share one seed, as the reference seeds every rank identically
+    (``torch.manual_seed(args.seed)``, gossip_sgd.py:172-175).
+    """
+    variables = model.init(rng, sample_input, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.int32(0),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        gossip=algorithm.init(params),
+    )

@@ -1,0 +1,175 @@
+"""The jitted train/eval steps: model + algorithm + optimizer + schedule.
+
+This is the compiled replacement for the reference's hot loop
+(gossip_sgd.py:369-426) *and* the wrapper machinery it drives: forward-pre
+hook (query + de-bias), backward hook (bias), optimizer step, transfer, and
+the gossip thread's mix all become one XLA program per rank
+(SURVEY.md §3.1).  The loop body does:
+
+    pre_step  → consume in-flight gossip (overlap)
+    eval      → de-biased params  →  forward/backward (bf16-friendly)
+    reduce    → exact local/AR gradient averaging
+    SGD       → torch-compatible update on the numerator params, LR from the
+                compiled schedule
+    post_step → gossip round (ppermute over ICI)
+
+Everything is sharded over the gossip mesh axis with ``shard_map``: each
+rank holds its own model replica (leading world dimension), its own batch
+shard, and its own gossip state.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..algorithms.api import GossipAlgorithm
+from ..parallel.collectives import as_scalar
+from ..parallel.mesh import GOSSIP_AXIS
+from .metrics import accuracy_topk, kl_div_loss, one_hot
+from .state import TrainState
+
+__all__ = ["build_train_step", "build_eval_step", "shard_train_step",
+           "shard_eval_step", "replicate_state", "unreplicate"]
+
+
+def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
+                     itr_per_epoch: int, num_classes: int,
+                     local_axis: str | None = None) -> tp.Callable:
+    """Returns the per-rank step ``(state, images, labels) -> (state, metrics)``.
+
+    Call inside ``shard_map`` (see :func:`shard_train_step`), or directly for
+    single-device debugging.
+
+    Args:
+      model: flax module with ``__call__(x, train)``.
+      algorithm: a :class:`GossipAlgorithm`.
+      tx: gradient transformation from :func:`~.state.sgd` (LR applied here).
+      lr_schedule: ``(epoch, itr, itr_per_epoch) -> lr`` (see lr.py).
+      itr_per_epoch: static iterations per epoch for the schedule.
+      num_classes: classifier width for one-hot targets.
+      local_axis: optional intra-node mesh axis; gradients and BN stats are
+        exactly averaged over it (≙ nprocs_per_node local all-reduce,
+        distributed.py:551-562 and BN buffer sync :269-276).
+    """
+
+    def train_step(state: TrainState, images, labels):
+        params, gstate = algorithm.pre_step(state.params, state.gossip)
+        z = algorithm.eval_params(params, gstate)
+
+        def loss_fn(p):
+            out, mutated = model.apply(
+                {"params": p, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            loss = kl_div_loss(out, one_hot(labels, num_classes))
+            return loss, (out, mutated["batch_stats"])
+
+        (loss, (logits, batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(z)
+
+        if local_axis is not None:
+            grads = jax.tree.map(lambda g: lax.pmean(g, local_axis), grads)
+            batch_stats = jax.tree.map(
+                lambda b: lax.pmean(b, local_axis), batch_stats)
+        grads = algorithm.reduce_grads(grads)
+
+        step = as_scalar(state.step)
+        epoch = step // itr_per_epoch
+        itr = step % itr_per_epoch
+        lr = lr_schedule(epoch, itr, itr_per_epoch)
+
+        updates, opt_state = tx.update(grads, state.opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: p - lr.astype(p.dtype) * u, params, updates)
+
+        params, gstate = algorithm.post_step(params, gstate)
+
+        top1, top5 = accuracy_topk(logits, labels, topk=(1, 5))
+        metrics = {"loss": loss, "top1": top1, "top5": top5, "lr": lr}
+        new_state = state.replace(
+            step=state.step + 1, params=params, batch_stats=batch_stats,
+            opt_state=opt_state, gossip=gstate)
+        return new_state, metrics
+
+    return train_step
+
+
+def build_eval_step(model, algorithm: GossipAlgorithm,
+                    num_classes: int) -> tp.Callable:
+    """Per-rank eval step: de-biased params, running BN stats, no gossip
+    (≙ ``validate``, gossip_sgd.py:440-471 — every rank evaluates
+    independently, no collectives)."""
+
+    def eval_step(state: TrainState, images, labels):
+        z = algorithm.eval_params(state.params, state.gossip)
+        logits = model.apply(
+            {"params": z, "batch_stats": state.batch_stats},
+            images, train=False)
+        loss = kl_div_loss(logits, one_hot(labels, num_classes))
+        top1, top5 = accuracy_topk(logits, labels, topk=(1, 5))
+        return {"loss": loss, "top1": top1, "top5": top5}
+
+    return eval_step
+
+
+def shard_train_step(step_fn, mesh, axis_name: str = GOSSIP_AXIS):
+    """Wrap a per-rank step for a 1-D gossip mesh.
+
+    Globally, every input/output leaf carries a leading world dimension
+    sharded over ``axis_name`` (each rank = one model replica + one batch
+    shard); the per-shard leading axis of size 1 is squeezed away before the
+    per-rank step runs and restored after, so ``step_fn`` is written in
+    plain single-rank terms.
+    """
+
+    def wrapped(state, images, labels):
+        squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
+        unsqueeze = lambda t: jax.tree.map(lambda a: a[None], t)
+        new_state, metrics = step_fn(
+            squeeze(state), squeeze(images), squeeze(labels))
+        return unsqueeze(new_state), unsqueeze(metrics)
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name)))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_eval_step(eval_fn, mesh, axis_name: str = GOSSIP_AXIS):
+    """Wrap a per-rank eval step for a 1-D gossip mesh (see
+    :func:`shard_train_step`); returns per-rank metrics stacked over the
+    world dimension."""
+
+    def wrapped(state, images, labels):
+        squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
+        metrics = eval_fn(squeeze(state), squeeze(images), squeeze(labels))
+        return jax.tree.map(lambda a: a[None], metrics)
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(axis_name))
+    return jax.jit(sharded)
+
+
+def replicate_state(state: TrainState, world_size: int) -> TrainState:
+    """Stack a single-rank state into the leading world dimension.
+
+    Every rank starts from identical values (same seed as the reference,
+    gossip_sgd.py:172-175); they diverge through data and gossip.
+    """
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(
+            jnp.asarray(a)[None], (world_size,) + jnp.shape(a)),
+        state)
+
+
+def unreplicate(tree, rank: int = 0):
+    """Extract one rank's slice of a world-stacked pytree."""
+    return jax.tree.map(lambda a: np.asarray(a)[rank], tree)

@@ -1,0 +1,6 @@
+"""Shared utilities: metering, logging."""
+
+from .logging import make_logger
+from .meter import Meter
+
+__all__ = ["Meter", "make_logger"]

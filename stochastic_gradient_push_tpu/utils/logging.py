@@ -1,0 +1,28 @@
+"""Rank-prefixed stdout logger (≙ gossip/utils/helpers.py:91-114).
+
+The reference includes ``%(threadName)s`` to tell gossip-thread lines from
+main-thread lines; there is no gossip thread here, but the field is kept so
+existing log-parsing tooling sees the same shape.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["make_logger"]
+
+
+def make_logger(rank: int | str, verbose: bool = True) -> logging.Logger:
+    # one logger per rank: this framework can simulate many ranks inside a
+    # single process, so the rank prefix must not be latched by first use
+    logger = logging.getLogger(f"{__name__}.rank{rank}")
+    if not getattr(logger, "handler_set", None):
+        console = logging.StreamHandler(stream=sys.stdout)
+        console.setFormatter(logging.Formatter(
+            f"{rank}: %(levelname)s -- %(threadName)s -- %(message)s"))
+        logger.addHandler(console)
+        logger.propagate = False
+        logger.handler_set = True
+    logger.setLevel(logging.DEBUG if verbose else logging.INFO)
+    return logger

@@ -1,0 +1,70 @@
+"""Running-statistics meter with the reference's exact CSV/str formats.
+
+Port of ``gossip/utils/metering.py:13-80`` (identical duplicate at
+``experiment_utils/metering.py``): tracks current value, mean, sample
+standard deviation, and (stateful mode) mean absolute deviation.  The
+``__str__`` formats are byte-compatible with the reference so the CSV logs
+it emits remain parseable by the reference's plotting layer
+(visualization/plotting.py:195-228).
+"""
+
+from __future__ import annotations
+
+__all__ = ["Meter"]
+
+
+class Meter:
+    """Computes and stores the average, variance, and current value."""
+
+    def __init__(self, init_dict: dict | None = None, ptag: str = "Time",
+                 stateful: bool = False, csv_format: bool = True):
+        self.reset()
+        self.ptag = ptag
+        self.value_history: list[float] | None = None
+        self.stateful = stateful
+        if self.stateful:
+            self.value_history = []
+        self.csv_format = csv_format
+        if init_dict is not None:
+            for key, val in init_dict.items():
+                if key in ("val", "avg", "sum", "count", "std", "sqsum",
+                           "mad", "ptag", "stateful", "csv_format",
+                           "value_history"):
+                    setattr(self, key, val)
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.std = 0.0
+        self.sqsum = 0.0
+        self.mad = 0.0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+        self.sqsum += (val ** 2) * n
+        if self.count > 1:
+            # clamp: float cancellation can drive the variance epsilon-negative
+            var = max(0.0, (self.sqsum - (self.sum ** 2) / self.count)
+                      / (self.count - 1))
+            self.std = var ** 0.5
+        if self.stateful:
+            self.value_history.append(val)
+            mad = sum(abs(v - self.avg) for v in self.value_history)
+            self.mad = mad / len(self.value_history)
+
+    def state_dict(self) -> dict:
+        """Snapshot for checkpointing (the reference stores
+        ``meter.__dict__``, gossip_sgd.py:214-216)."""
+        return dict(self.__dict__)
+
+    def __str__(self) -> str:
+        if self.csv_format:
+            spread = self.mad if self.stateful else self.std
+            return f"{self.val:.3f},{self.avg:.3f},{spread:.3f}"
+        spread = self.mad if self.stateful else self.std
+        return f"{self.ptag}: {self.val:.3f} ({self.avg:.3f} +- {spread:.3f})"

@@ -1,0 +1,173 @@
+"""Model structure, loss/accuracy parity, LR schedule, Meter, SGD parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.models import TinyCNN, resnet50
+from stochastic_gradient_push_tpu.train import (
+    LRSchedule,
+    accuracy_topk,
+    kl_div_loss,
+    one_hot,
+    ppi_at_epoch,
+    sgd,
+)
+from stochastic_gradient_push_tpu.utils import Meter
+
+
+def test_resnet50_structure_and_init():
+    model = resnet50(num_classes=1000)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)), train=True))
+    params = variables["params"]
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    # torchvision resnet50 has 25.557M params
+    assert abs(n_params - 25_557_032) / 25_557_032 < 0.01, n_params
+    assert "batch_stats" in variables
+
+
+def test_resnet_zero_gamma_and_fc_init():
+    model = resnet50(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=True)
+    params = variables["params"]
+    # every bottleneck's final norm scale starts at zero
+    zero_scales = [
+        k2 for k, v in params.items() if k.startswith("Bottleneck")
+        for k2, v2 in v.items()
+        if k2 == "BatchNorm_2" and float(np.abs(v2["scale"]).max()) == 0.0]
+    assert len(zero_scales) == 16  # 3+4+6+3 blocks
+    # fc ~ N(0, 0.01)
+    fc = np.asarray(params["fc"]["kernel"])
+    assert 0.005 < fc.std() < 0.02
+    # forward pass at init: residual blocks are identity-like, logits finite
+    out = model.apply(variables, jnp.ones((2, 32, 32, 3)), train=False)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_kl_div_loss_equals_cross_entropy_for_one_hot():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 10)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=(8,)))
+    got = kl_div_loss(logits, one_hot(labels, 10))
+    # cross entropy
+    logp = jax.nn.log_softmax(logits)
+    want = -jnp.mean(logp[jnp.arange(8), labels])
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+
+def test_kl_div_loss_soft_targets_matches_torch_formula():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(4, 6)).astype(np.float32)
+    target = rng.dirichlet(np.ones(6), size=4).astype(np.float32)
+    got = float(kl_div_loss(jnp.asarray(logits), jnp.asarray(target)))
+    # torch KLDivLoss(batchmean): sum(t * (log t - log q)) / N
+    logq = np.asarray(jax.nn.log_softmax(jnp.asarray(logits)))
+    want = float(np.sum(target * (np.log(target) - logq)) / 4)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_accuracy_topk():
+    logits = jnp.asarray([[0.1, 0.9, 0.0, 0.0],
+                          [0.9, 0.1, 0.0, 0.0],
+                          [0.0, 0.1, 0.2, 0.7],
+                          [0.5, 0.4, 0.05, 0.05]], jnp.float32)
+    labels = jnp.asarray([1, 3, 2, 0])
+    top1, top2 = accuracy_topk(logits, labels, topk=(1, 2))
+    assert float(top1) == 50.0   # rows 0 and 3 correct
+    assert float(top2) == 75.0   # row 2 recovered at k=2; row 1 still missed
+
+
+def test_lr_schedule_matches_reference_rule():
+    # 32 ranks x 256-per-node batch = the paper's flagship config
+    s = LRSchedule(ref_lr=0.1, batch_size=256, world_size=32,
+                   decay_schedule={30: 0.1, 60: 0.1, 80: 0.1}, warmup=True)
+    target = 0.1 * 256 * 32 / 256
+    itr_per_epoch = 156
+    # warmup: epoch 0 itr 0 → ref_lr + (target-ref)/(5*ipe)
+    lr0 = float(s(0, 0, itr_per_epoch))
+    np.testing.assert_allclose(
+        lr0, 0.1 + (target - 0.1) / (5 * itr_per_epoch), rtol=1e-5)
+    # end of warmup → target
+    np.testing.assert_allclose(float(s(4, 155, itr_per_epoch)), target,
+                               rtol=1e-3)
+    # piecewise decays
+    np.testing.assert_allclose(float(s(30, 0, itr_per_epoch)), target * 0.1,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(s(60, 0, itr_per_epoch)), target * 0.01,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(s(85, 0, itr_per_epoch)), target * 1e-3,
+                               rtol=1e-5)
+
+
+def test_lr_schedule_no_warmup_small_world():
+    # target <= ref_lr → warmup clamps to target (gossip_sgd.py:519-521)
+    s = LRSchedule(ref_lr=0.1, batch_size=32, world_size=1, warmup=True)
+    assert float(s(0, 0, 100)) == pytest.approx(0.1 * 32 / 256)
+
+
+def test_ppi_schedule_lookup():
+    sched = {0: 1, 10: 2, 50: 4}
+    assert ppi_at_epoch(sched, 0) == 1
+    assert ppi_at_epoch(sched, 9) == 1
+    assert ppi_at_epoch(sched, 10) == 2
+    assert ppi_at_epoch(sched, 49) == 2
+    assert ppi_at_epoch(sched, 89) == 4
+    with pytest.raises(ValueError):
+        ppi_at_epoch({5: 2}, 0)
+
+
+def test_meter_stats_and_format():
+    m = Meter(ptag="Time")
+    for v in (1.0, 2.0, 3.0):
+        m.update(v)
+    assert m.avg == pytest.approx(2.0)
+    assert m.std == pytest.approx(1.0)
+    assert str(m) == "3.000,2.000,1.000"
+    m2 = Meter(init_dict=m.state_dict())
+    assert m2.avg == pytest.approx(2.0)
+    stateful = Meter(ptag="Gossip", stateful=True, csv_format=False)
+    stateful.update(1.0)
+    stateful.update(3.0)
+    assert "Gossip: 3.000 (2.000 +- 1.000)" == str(stateful)
+
+
+def test_sgd_matches_torch_semantics():
+    """Verify against torch.optim.SGD on a tiny problem."""
+    import torch
+
+    w0 = np.array([1.0, -2.0, 3.0], dtype=np.float32)
+    grads_seq = [np.array([0.5, -1.0, 0.25], dtype=np.float32),
+                 np.array([-0.3, 0.2, 0.8], dtype=np.float32),
+                 np.array([0.1, 0.1, -0.1], dtype=np.float32)]
+    lr, mu, wd = 0.1, 0.9, 1e-2
+
+    for nesterov in (False, True):
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        topt = torch.optim.SGD([tw], lr=lr, momentum=mu, weight_decay=wd,
+                               nesterov=nesterov)
+        tx = sgd(momentum=mu, weight_decay=wd, nesterov=nesterov)
+        jw = jnp.asarray(w0)
+        jstate = tx.init(jw)
+        for g in grads_seq:
+            topt.zero_grad()
+            tw.grad = torch.tensor(g)
+            topt.step()
+            updates, jstate = tx.update(jnp.asarray(g), jstate, jw)
+            jw = jw - lr * updates
+        np.testing.assert_allclose(np.asarray(jw), tw.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_tiny_cnn_forward():
+    model = TinyCNN(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 16, 16, 3)), train=True)
+    out, mutated = model.apply(variables, jnp.ones((2, 16, 16, 3)),
+                               train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    assert "batch_stats" in mutated
