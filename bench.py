@@ -1,0 +1,111 @@
+"""Headline benchmark: ResNet-50 SGP train-step throughput on TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference's headline benchmark family is ResNet-50/ImageNet
+time-per-iteration and derived images/sec (BASELINE.md; reference
+visualization/plotting.py:315-345).  The repo publishes no absolute numbers
+(SURVEY.md §6), so the baseline constant below is the per-worker throughput
+implied by the paper's hardware class: a V100 running the reference recipe
+(fp32, per-GPU batch 32, NCCL/gossip overhead included) sustains roughly
+300 images/sec/worker.  ``vs_baseline`` = our images/sec per chip / 300.
+
+This runs the *full* SGP train step (forward, backward, torch-semantics SGD,
+push-sum gossip round, metrics) — on a single chip the gossip collective
+degenerates to identity but stays in the program, so the compiled step is
+structurally identical to the multi-chip one.
+"""
+
+import json
+import os
+import time
+
+# honor a user-forced platform but default to the real TPU
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from stochastic_gradient_push_tpu.algorithms import sgp
+from stochastic_gradient_push_tpu.data import synthetic_classification
+from stochastic_gradient_push_tpu.models import resnet50
+from stochastic_gradient_push_tpu.parallel import GOSSIP_AXIS, make_gossip_mesh
+from stochastic_gradient_push_tpu.topology import (
+    NPeerDynamicDirectedExponentialGraph,
+    RingGraph,
+    build_schedule,
+)
+from stochastic_gradient_push_tpu.train import (
+    LRSchedule,
+    build_train_step,
+    init_train_state,
+    replicate_state,
+    sgd,
+    shard_train_step,
+)
+
+REFERENCE_IMAGES_PER_SEC_PER_WORKER = 300.0  # see module docstring
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def main():
+    world = jax.device_count()
+    mesh = make_gossip_mesh(world)
+
+    model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    graph_cls = (NPeerDynamicDirectedExponentialGraph if world > 2
+                 else RingGraph)
+    graph = graph_cls(world, peers_per_itr=1) if world > 1 else \
+        NPeerDynamicDirectedExponentialGraph(1, peers_per_itr=1)
+    schedule = build_schedule(graph)
+    alg = sgp(schedule, GOSSIP_AXIS)
+    tx = sgd(momentum=0.9, weight_decay=1e-4, nesterov=True)
+    lr_sched = LRSchedule(ref_lr=0.1, batch_size=BATCH, world_size=world,
+                          warmup=True)
+    step = build_train_step(model, alg, tx, lr_sched, itr_per_epoch=1000,
+                            num_classes=1000)
+    train_fn = shard_train_step(step, mesh)
+
+    state = replicate_state(
+        init_train_state(model, jax.random.PRNGKey(0),
+                         jnp.zeros((BATCH, IMAGE, IMAGE, 3), jnp.float32),
+                         tx, alg),
+        world)
+
+    images, labels = synthetic_classification(
+        world * BATCH, num_classes=1000, image_size=IMAGE, seed=0)
+    x = images.reshape(world, BATCH, IMAGE, IMAGE, 3)
+    y = labels.reshape(world, BATCH)
+
+    for _ in range(WARMUP):
+        state, metrics = train_fn(state, x, y)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = train_fn(state, x, y)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    time_per_itr = dt / STEPS
+    images_per_sec = world * BATCH / time_per_itr
+    per_chip = images_per_sec / world
+
+    print(json.dumps({
+        "metric": "resnet50_sgp_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            per_chip / REFERENCE_IMAGES_PER_SEC_PER_WORKER, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Shared launch plumbing for TPU pods.
+#
+# The reference launches one process per GPU via SLURM srun
+# (job_scripts/*.sh). On TPU a single python process per host drives all
+# local chips through one jax.sharding.Mesh; on a multi-host pod slice the
+# same script simply runs on every host (jax.distributed handles rendezvous
+# via the TPU metadata service). Typical invocations:
+#
+#   single host:   bash launch/launch_sgp.sh
+#   GCP pod slice: gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#                    --command="cd $REPO && bash launch/launch_sgp.sh"
+#   SLURM cluster: sbatch --nodes=$N launch/launch_sgp.sh
+#
+# Canonical hyperparameters follow the paper recipe encoded in
+# job_scripts/submit_*_IB.sh: 90 epochs, nesterov, 5-epoch warmup,
+# lr x0.1 at epochs 30/60/80, seed 1.
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_ROOT:$PYTHONPATH"
+RUN="python -u -m stochastic_gradient_push_tpu.run.gossip_sgd"
+RUN_ADPSGD="python -u -m stochastic_gradient_push_tpu.run.gossip_sgd_adpsgd"
+COMMON_ARGS=(
+  --batch_size 32 --lr 0.1 --num_epochs 90
+  --nesterov True --warmup True
+  --schedule 30 0.1 60 0.1 80 0.1
+  --train_fast False --print_freq 100 --verbose False --seed 1
+  --checkpoint_dir "${CHECKPOINT_DIR:-./checkpoints}"
+  --dataset_dir "${IMAGENET_DIR:-/datasets/imagenet}"
+)

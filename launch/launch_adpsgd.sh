@@ -1,0 +1,5 @@
+#!/bin/bash
+# AD-PSGD (≙ submit_ADPSGD_ETH.sh): bilateral pairwise averaging over
+# rotating perfect matchings.
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+$RUN_ADPSGD "${COMMON_ARGS[@]}" --tag 'ADPSGD_TPU' "$@"

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Fast end-to-end smoke run on a virtual 8-device CPU mesh — the test
+# capability the reference lacks (SURVEY.md §4).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+python -u -m stochastic_gradient_push_tpu.run.gossip_sgd \
+  --dataset synthetic --world_size 8 --model tiny_cnn --num_classes 4 \
+  --image_size 8 --batch_size 8 --num_epochs 2 \
+  --checkpoint_dir "${CHECKPOINT_DIR:-/tmp/sgp_smoke}" "$@"

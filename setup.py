@@ -1,0 +1,37 @@
+"""Package metadata (≙ the reference's setup.py packaging of `gossip` v0.1).
+
+The `[parse]` extra mirrors the reference's plotting dependencies
+(setup.py:33-39 there); core deps are the baked-in JAX stack.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="stochastic_gradient_push_tpu",
+    version="0.1.0",
+    description=("TPU-native decentralized data-parallel training: "
+                 "AllReduce SGD, Stochastic Gradient Push, Overlap SGP, "
+                 "D-PSGD, and AD-PSGD over time-varying gossip topologies "
+                 "compiled to XLA collectives"),
+    packages=find_packages(
+        include=["stochastic_gradient_push_tpu",
+                 "stochastic_gradient_push_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "numpy",
+    ],
+    extras_require={
+        "parse": ["pandas", "matplotlib"],
+        "imagefolder": ["torch", "torchvision"],
+    },
+    entry_points={
+        "console_scripts": [
+            "gossip-sgd=stochastic_gradient_push_tpu.run.gossip_sgd:main",
+            "gossip-sgd-adpsgd="
+            "stochastic_gradient_push_tpu.run.gossip_sgd_adpsgd:main",
+        ],
+    },
+)

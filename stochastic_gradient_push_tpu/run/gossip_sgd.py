@@ -1,0 +1,248 @@
+"""Gossip SGD CLI — decentralized data-parallel training on a TPU mesh.
+
+Flag-compatible port of the reference's experiment harness
+(gossip_sgd.py:72-159): same names, same string-encoded booleans, same
+integer-coded graph/mixing registries, same flat-list schedule encodings.
+Flags that only managed host-side distribution (master address/port, NCCL
+backend, NIC type, dataloader workers, cuda streams) are accepted but
+ignored, so existing launch scripts keep working.
+
+New flags for the TPU world: ``--world_size`` (mesh size; default all
+devices), ``--nprocs_per_node`` (hierarchical mesh), ``--model``,
+``--dataset synthetic|imagefolder``, ``--image_size``.
+
+Run (virtual 8-device CPU mesh):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python -m stochastic_gradient_push_tpu.run.gossip_sgd \\
+      --dataset synthetic --world_size 8 --num_epochs 1 \\
+      --num_iterations_per_training_epoch 5 --checkpoint_dir /tmp/ckpt/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..topology import GRAPH_TOPOLOGIES, MIXING_STRATEGIES
+
+__all__ = ["build_parser", "parse_config", "main"]
+
+
+def _str_bool(v: str) -> bool:
+    return str(v) == "True"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Gossip SGD on TPU")
+    # reference flag surface (gossip_sgd.py:72-159)
+    p.add_argument("--all_reduce", default="False", type=str)
+    p.add_argument("--batch_size", default=32, type=int,
+                   help="per-agent batch size")
+    p.add_argument("--lr", default=0.1, type=float,
+                   help="reference lr for a 256-sample global batch")
+    p.add_argument("--num_dataloader_workers", default=0, type=int,
+                   help="accepted for compatibility; loading is in-process")
+    p.add_argument("--num_epochs", default=90, type=int)
+    p.add_argument("--num_iterations_per_training_epoch", default=None,
+                   type=int, help="early exit for testing")
+    p.add_argument("--momentum", default=0.9, type=float)
+    p.add_argument("--weight_decay", default=1e-4, type=float)
+    p.add_argument("--nesterov", default="False", type=str)
+    p.add_argument("--push_sum", default="True", type=str)
+    p.add_argument("--graph_type", default=5, type=int,
+                   choices=list(GRAPH_TOPOLOGIES))
+    p.add_argument("--mixing_strategy", default=0, type=int,
+                   choices=list(MIXING_STRATEGIES))
+    p.add_argument("--schedule", nargs="+", default=[30, 0.1, 60, 0.1, 80, 0.1],
+                   type=float, help="lr schedule as epoch value pairs")
+    p.add_argument("--peers_per_itr_schedule", nargs="+", type=int,
+                   default=None)
+    p.add_argument("--overlap", default="False", type=str)
+    p.add_argument("--synch_freq", default=0, type=int,
+                   help="accepted for compatibility; staleness is one step")
+    p.add_argument("--warmup", default="False", type=str)
+    p.add_argument("--seed", default=47, type=int)
+    p.add_argument("--resume", default="False", type=str)
+    p.add_argument("--backend", default="xla",
+                   choices=["xla", "nccl", "gloo", "mpi"],
+                   help="accepted for compatibility; comm is XLA/ICI")
+    p.add_argument("--tag", default="", type=str)
+    p.add_argument("--print_freq", default=10, type=int)
+    p.add_argument("--verbose", default="True", type=str)
+    p.add_argument("--train_fast", default="False", type=str)
+    p.add_argument("--checkpoint_all", default="True", type=str)
+    p.add_argument("--overwrite_checkpoints", default="True", type=str)
+    p.add_argument("--master_port", default="40100", type=str,
+                   help="accepted for compatibility; unused")
+    p.add_argument("--checkpoint_dir", type=str, default="./checkpoints")
+    p.add_argument("--network_interface_type", default="infiniband",
+                   choices=["infiniband", "ethernet"],
+                   help="accepted for compatibility; unused")
+    p.add_argument("--num_itr_ignore", type=int, default=10)
+    p.add_argument("--dataset_dir", type=str, default=None)
+    p.add_argument("--no_cuda_streams", action="store_true",
+                   help="accepted for compatibility; unused")
+    # TPU-native additions
+    p.add_argument("--world_size", default=None, type=int,
+                   help="gossip ranks (default: all devices)")
+    p.add_argument("--nprocs_per_node", default=1, type=int,
+                   help="local mesh axis for hierarchical gossip")
+    p.add_argument("--model", default="resnet50", type=str)
+    p.add_argument("--dataset", default="imagefolder",
+                   choices=["imagefolder", "synthetic"])
+    p.add_argument("--image_size", default=224, type=int)
+    p.add_argument("--num_classes", default=1000, type=int)
+    p.add_argument("--synthetic_samples", default=None, type=int)
+    p.add_argument("--requeue_command", default=None, type=str,
+                   help="command run by rank 0 on preemption requeue")
+    return p
+
+
+def _parse_pair_schedule(flat, value_type=float) -> dict:
+    """epoch/value flat list → dict (gossip_sgd.py:624-649)."""
+    if len(flat) % 2:
+        raise SystemExit(
+            f"schedule {flat} must be epoch/value pairs (even length)")
+    out = {}
+    it = iter(flat)
+    for epoch in it:
+        out[int(epoch)] = value_type(next(it))
+    return out
+
+
+def parse_config(argv=None):
+    from ..train.loop import TrainerConfig
+
+    args = build_parser().parse_args(argv)
+    lr_schedule = _parse_pair_schedule(args.schedule, float)
+    ppi_flat = args.peers_per_itr_schedule or [0, 1]
+    ppi_schedule = _parse_pair_schedule(ppi_flat, int)
+    if 0 not in ppi_schedule:
+        raise SystemExit("peers_per_itr_schedule must include epoch 0")
+    all_reduce = _str_bool(args.all_reduce)
+    if all_reduce and args.graph_type != -1:
+        raise SystemExit("--all_reduce True requires --graph_type -1")
+    if not all_reduce and GRAPH_TOPOLOGIES[args.graph_type] is None:
+        raise SystemExit("gossip training requires a graph_type >= 0")
+
+    cfg = TrainerConfig(
+        all_reduce=all_reduce,
+        push_sum=_str_bool(args.push_sum),
+        overlap=_str_bool(args.overlap),
+        bilat=getattr(args, "bilat", False),
+        graph_class=GRAPH_TOPOLOGIES[args.graph_type],
+        mixing_class=MIXING_STRATEGIES[args.mixing_strategy],
+        ppi_schedule=ppi_schedule,
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.weight_decay,
+        nesterov=_str_bool(args.nesterov),
+        lr_schedule=lr_schedule,
+        warmup=_str_bool(args.warmup),
+        batch_size=args.batch_size,
+        num_epochs=args.num_epochs,
+        num_iterations_per_training_epoch=(
+            args.num_iterations_per_training_epoch),
+        seed=args.seed,
+        num_itr_ignore=args.num_itr_ignore,
+        print_freq=args.print_freq,
+        train_fast=_str_bool(args.train_fast),
+        verbose=_str_bool(args.verbose),
+        checkpoint_dir=args.checkpoint_dir,
+        tag=args.tag,
+        resume=_str_bool(args.resume),
+        checkpoint_all=_str_bool(args.checkpoint_all),
+        overwrite_checkpoints=_str_bool(args.overwrite_checkpoints),
+        num_classes=args.num_classes,
+    )
+    return cfg, args
+
+
+def main(argv=None, config_transform=None, extra_args=None):
+    cfg, args = parse_config(argv)
+    if extra_args:
+        for k, v in extra_args.items():
+            setattr(args, k, v)
+    if config_transform is not None:
+        cfg = config_transform(cfg, args)
+
+    import jax
+
+    # the JAX_PLATFORMS env var is authoritative even when a platform
+    # plugin's sitecustomize pinned jax_platforms at interpreter start
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from ..data import (DistributedSampler, ShardedLoader,
+                        imagefolder_arrays, synthetic_classification)
+    from ..models import RESNETS, TinyCNN
+    from ..parallel import make_gossip_mesh, make_hierarchical_mesh
+    from ..train.loop import Trainer
+    from ..utils import make_logger
+    from ..utils.checkpoint import CheckpointManager, ClusterManager
+
+    log = make_logger("main", cfg.verbose)
+    world = args.world_size or jax.device_count()
+    if args.nprocs_per_node > 1:
+        cfg.nprocs_per_node = args.nprocs_per_node
+        mesh = make_hierarchical_mesh(args.nprocs_per_node, world)
+    else:
+        mesh = make_gossip_mesh(world)
+    log.info(f"mesh: {mesh}; devices: {world}")
+
+    if args.model in RESNETS:
+        model = RESNETS[args.model](num_classes=cfg.num_classes)
+    elif args.model == "tiny_cnn":
+        model = TinyCNN(num_classes=cfg.num_classes)
+    else:
+        raise SystemExit(f"unknown model {args.model}")
+
+    if args.dataset == "synthetic":
+        n = args.synthetic_samples or world * cfg.batch_size * 8
+        n_val = max(world * cfg.batch_size, n // 8)
+        # one draw, then split: train and val share class structure
+        all_images, all_labels = synthetic_classification(
+            n + n_val, num_classes=cfg.num_classes,
+            image_size=args.image_size, seed=cfg.seed)
+        images, labels = all_images[:n], all_labels[:n]
+        val_images, val_labels = all_images[n:], all_labels[n:]
+    else:
+        if not args.dataset_dir:
+            raise SystemExit("--dataset_dir required for imagefolder")
+        images, labels = imagefolder_arrays(
+            args.dataset_dir, "train", args.image_size, train=True)
+        val_images, val_labels = imagefolder_arrays(
+            args.dataset_dir, "val", args.image_size, train=False)
+
+    sampler = DistributedSampler(len(images), world)
+    loader = ShardedLoader(images, labels, cfg.batch_size, sampler)
+    val_sampler = DistributedSampler(len(val_images), world)
+    val_loader = ShardedLoader(val_images, val_labels, cfg.batch_size,
+                               val_sampler)
+
+    ckpt = CheckpointManager(cfg.checkpoint_dir, tag=cfg.tag,
+                             world_size=world,
+                             all_workers=cfg.checkpoint_all)
+    cluster = ClusterManager(ckpt, requeue_command=args.requeue_command or
+                             _default_requeue())
+
+    trainer = Trainer(cfg, model, mesh,
+                      sample_input_shape=(
+                          cfg.batch_size, args.image_size, args.image_size,
+                          images.shape[-1]),
+                      cluster_manager=cluster)
+    state = trainer.init_state()
+    state, result = trainer.fit(state, loader, sampler, val_loader)
+    log.info(f"done: {result['best_prec1']:.3f} best top-1, "
+             f"elapsed {result['elapsed_time']:.1f}s")
+    return result
+
+
+def _default_requeue() -> str | None:
+    job_id = os.environ.get("SLURM_JOB_ID")
+    return f"scontrol requeue {job_id}" if job_id else None
+
+
+if __name__ == "__main__":
+    main()

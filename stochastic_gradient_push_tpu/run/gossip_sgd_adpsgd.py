@@ -1,0 +1,42 @@
+"""AD-PSGD CLI — bilateral gossip training (≙ gossip_sgd_adpsgd.py).
+
+The reference's AD-PSGD script differs from gossip_sgd.py in ways that are
+all artifacts of host-side asynchrony: a second OS process with its own
+optimizer and process group (ad_psgd.py:120-133, 252-366), a file-size-based
+global iteration counter (gossip_sgd_adpsgd.py:509-523), manual LR
+propagation into the gossip process (:478-506), and gossip enable/disable
+around validation (:341, :421).  In the compiled formulation none of those
+exist: bilateral averaging is part of the train step, the LR schedule is
+compiled in, the global step is the state's step counter, and evaluation
+simply doesn't run the gossip collective.  What remains is flag surface:
+``--num_peers`` selects bilateral partners per iteration and the default
+graph is the bipartite exponential graph, matching the reference defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .gossip_sgd import main as base_main
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    # peel off the AD-PSGD-specific flag, forward the rest
+    peel = argparse.ArgumentParser(add_help=False)
+    peel.add_argument("--num_peers", default=1, type=int)
+    peel.add_argument("--graph_type", default=1, type=int)
+    known, rest = peel.parse_known_args(argv)
+    forwarded = rest + ["--graph_type", str(known.graph_type)]
+
+    def to_bilat(cfg, args):
+        cfg.bilat = True
+        cfg.ppi_schedule = {0: known.num_peers}
+        return cfg
+
+    return base_main(forwarded, config_transform=to_bilat)
+
+
+if __name__ == "__main__":
+    main()

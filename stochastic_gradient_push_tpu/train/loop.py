@@ -1,0 +1,343 @@
+"""The experiment loop: epochs, meters, CSV logging, validation, resume.
+
+Port of the reference harness's control flow (gossip_sgd.py:163-471) minus
+everything that was only there to manage host-side distribution (process
+groups, barriers, NIC pinning).  The CSV schema is byte-compatible with the
+reference (header at gossip_sgd.py:262-274, rows at :408-418, :318-327) so
+the reference's plotting layer parses these logs unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import typing as tp
+
+import jax
+import numpy as np
+
+from ..algorithms import GossipAlgorithm, adpsgd, all_reduce, dpsgd, sgp
+from ..parallel.mesh import GOSSIP_AXIS, LOCAL_AXIS, NODE_AXIS
+from ..topology import build_pairing_schedule, build_schedule
+from ..utils import Meter, make_logger
+from ..utils.checkpoint import ClusterManager
+from .lr import LRSchedule, ppi_at_epoch
+from .state import init_train_state, sgd
+from .step import (
+    build_eval_step,
+    build_train_step,
+    replicate_state,
+    shard_eval_step,
+    shard_train_step,
+)
+
+__all__ = ["TrainerConfig", "Trainer"]
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Experiment configuration (≙ the reference CLI surface,
+    gossip_sgd.py:72-159)."""
+
+    # algorithm selection
+    all_reduce: bool = False
+    push_sum: bool = True
+    overlap: bool = False
+    bilat: bool = False                       # AD-PSGD family
+    graph_class: tp.Any = None                # GraphTopology subclass
+    mixing_class: tp.Any = None               # MixingStrategy subclass
+    ppi_schedule: dict[int, int] = dataclasses.field(
+        default_factory=lambda: {0: 1})
+
+    # optimization
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    nesterov: bool = False
+    lr_schedule: dict[int, float] = dataclasses.field(
+        default_factory=lambda: {30: 0.1, 60: 0.1, 80: 0.1})
+    warmup: bool = False
+
+    # run shape
+    batch_size: int = 32                      # per-rank
+    num_epochs: int = 90
+    num_iterations_per_training_epoch: int | None = None
+    seed: int = 47
+    num_itr_ignore: int = 10
+    print_freq: int = 10
+    train_fast: bool = False
+    verbose: bool = True
+
+    # io
+    checkpoint_dir: str = "./checkpoints"
+    tag: str = ""
+    resume: bool = False
+    checkpoint_all: bool = True
+    overwrite_checkpoints: bool = True
+
+    num_classes: int = 1000
+    # hierarchical gossip: exact psum averaging inside a node, gossip
+    # between nodes (≙ nprocs_per_node, distributed.py:62-78)
+    nprocs_per_node: int = 1
+
+
+class Trainer:
+    """Drives training of ``model`` over ``mesh`` with the configured
+    decentralized algorithm."""
+
+    def __init__(self, config: TrainerConfig, model, mesh,
+                 sample_input_shape: tuple[int, ...],
+                 cluster_manager: ClusterManager | None = None):
+        self.cfg = config
+        self.model = model
+        self.mesh = mesh
+        self.world_size = mesh.devices.size      # data/LR world (all devices)
+        if config.nprocs_per_node > 1:
+            if mesh.shape.get(LOCAL_AXIS) != config.nprocs_per_node:
+                raise ValueError(
+                    f"nprocs_per_node={config.nprocs_per_node} requires a "
+                    f"hierarchical mesh with a '{LOCAL_AXIS}' axis of that "
+                    f"size; got {mesh}")
+            self.gossip_axis = NODE_AXIS
+            self.local_axis = LOCAL_AXIS
+            self.gossip_world = mesh.shape[NODE_AXIS]
+        else:
+            self.gossip_axis = GOSSIP_AXIS
+            self.local_axis = None
+            self.gossip_world = self.world_size
+        self.log = make_logger("trainer", config.verbose)
+        self.cluster = cluster_manager
+        self.sample_input_shape = sample_input_shape
+
+        self.tx = sgd(momentum=config.momentum,
+                      weight_decay=config.weight_decay,
+                      nesterov=config.nesterov)
+        self.lr_schedule_obj = None  # built per-fit (needs itr_per_epoch)
+        self._step_cache: dict[tuple, tp.Callable] = {}
+        self._current_ppi: int | None = None
+        self._eval_fn = None
+
+        self.out_fname = os.path.join(
+            config.checkpoint_dir,
+            f"{config.tag}out_r0_n{self.world_size}.csv")
+
+    # -- algorithm / step construction ------------------------------------
+
+    def make_algorithm(self, ppi: int) -> GossipAlgorithm:
+        cfg = self.cfg
+        axis = self.gossip_axis
+        if cfg.all_reduce:
+            return all_reduce(axis)
+        graph = cfg.graph_class(self.gossip_world, peers_per_itr=ppi)
+        if cfg.bilat:
+            return adpsgd(build_pairing_schedule(graph), axis)
+        mixing = cfg.mixing_class() if cfg.mixing_class else None
+        schedule = build_schedule(graph, mixing)
+        if cfg.push_sum:
+            return sgp(schedule, axis, overlap=cfg.overlap)
+        return dpsgd(schedule, axis, overlap=cfg.overlap)
+
+    def _train_fn(self, ppi: int, itr_per_epoch: int):
+        """Compiled step for a peers-per-itr value; each distinct ppi is its
+        own compiled variant (SURVEY.md §7 hard part #2 — the reference
+        mutates the gossiper in place, gossip_sgd.py:497-505)."""
+        key = (ppi, itr_per_epoch)
+        if key not in self._step_cache:
+            alg = self.make_algorithm(ppi)
+            step = build_train_step(
+                self.model, alg, self.tx, self.lr_schedule_obj,
+                itr_per_epoch=itr_per_epoch, num_classes=self.cfg.num_classes,
+                local_axis=self.local_axis)
+            self._step_cache[key] = (alg, shard_train_step(
+                step, self.mesh, self.gossip_axis, self.local_axis))
+        return self._step_cache[key]
+
+    # -- csv logging -------------------------------------------------------
+
+    def _init_csv(self) -> None:
+        os.makedirs(self.cfg.checkpoint_dir, exist_ok=True)
+        if not os.path.exists(self.out_fname):
+            with open(self.out_fname, "w") as f:
+                print("BEGIN-TRAINING\n"
+                      f"World-Size,{self.world_size}\n"
+                      "Num-DLWorkers,0\n"
+                      f"Batch-Size,{self.cfg.batch_size}\n"
+                      "Epoch,itr,BT(s),avg:BT(s),std:BT(s),"
+                      "NT(s),avg:NT(s),std:NT(s),"
+                      "DT(s),avg:DT(s),std:DT(s),"
+                      "Loss,avg:Loss,Prec@1,avg:Prec@1,Prec@5,avg:Prec@5,val",
+                      file=f)
+
+    def _log_row(self, epoch, itr, meters, losses, top1, top5) -> None:
+        bt, nt, dt = meters
+        with open(self.out_fname, "a") as f:
+            print(f"{epoch},{itr},{bt},{nt},{dt},"
+                  f"{losses.val:.4f},{losses.avg:.4f},"
+                  f"{top1.val:.3f},{top1.avg:.3f},"
+                  f"{top5.val:.3f},{top5.avg:.3f},-1", file=f)
+
+    def _log_val_row(self, epoch, meters, val) -> None:
+        bt, nt, dt = meters
+        with open(self.out_fname, "a") as f:
+            print(f"{epoch},-1,{bt},{nt},{dt},-1,-1,-1,-1,-1,-1,{val}",
+                  file=f)
+
+    # -- main entry points -------------------------------------------------
+
+    def init_state(self):
+        import jax.numpy as jnp
+        alg = self.make_algorithm(ppi_at_epoch(self.cfg.ppi_schedule, 0))
+        state = init_train_state(
+            self.model, jax.random.PRNGKey(self.cfg.seed),
+            jnp.zeros(self.sample_input_shape), self.tx, alg)
+        return replicate_state(state, self.gossip_world)
+
+    def fit(self, state, train_loader, sampler,
+            val_loader=None) -> tuple[tp.Any, dict]:
+        cfg = self.cfg
+        if len(train_loader) < 1:
+            raise ValueError(
+                "train loader yields zero batches: batch_size × world_size "
+                "exceeds the dataset size")
+        # the compiled schedule derives the epoch from state.step, so the
+        # per-epoch iteration count must reflect any early-exit cap or the
+        # LR trajectory desynchronizes from the host epoch
+        itr_per_epoch = len(train_loader)
+        cap = cfg.num_iterations_per_training_epoch
+        if cap not in (None, -1):
+            itr_per_epoch = min(itr_per_epoch, cap)
+        self.lr_schedule_obj = LRSchedule(
+            ref_lr=cfg.lr, batch_size=cfg.batch_size,
+            world_size=self.world_size, decay_schedule=cfg.lr_schedule,
+            warmup=cfg.warmup)
+        self._init_csv()
+
+        batch_meter = Meter(ptag="Time")
+        nn_meter = Meter(ptag="Forward/Backward")
+        data_meter = Meter(ptag="Data")
+        meters = (batch_meter, nn_meter, data_meter)
+
+        start_epoch, start_itr, best_prec1 = 0, 0, 0.0
+        elapsed = 0.0
+
+        if cfg.resume and self.cluster is not None \
+                and self.cluster.ckpt.exists():
+            state, meta = self.cluster.ckpt.restore(state)
+            start_epoch = meta.get("epoch", 0)
+            start_itr = meta.get("itr", 0)
+            best_prec1 = meta.get("best_prec1", 0.0)
+            elapsed = meta.get("elapsed_time", 0.0)
+            for m, k in zip(meters, ("batch_meter", "nn_meter",
+                                     "data_meter")):
+                if k in meta:
+                    m.__dict__.update(meta[k])
+            self.log.info(f"resumed from epoch {start_epoch} itr {start_itr}")
+
+        begin_time = time.time() - elapsed
+        final_prec1 = 0.0
+        for epoch in range(start_epoch, cfg.num_epochs):
+            sampler.set_epoch(epoch + cfg.seed * 90)  # gossip_sgd.py:289
+            ppi = (ppi_at_epoch(cfg.ppi_schedule, epoch)
+                   if not cfg.all_reduce else 1)
+            alg, train_fn = self._train_fn(ppi, itr_per_epoch)
+
+            state = self._train_epoch(
+                state, train_fn, train_loader, epoch, start_itr, meters)
+            start_itr = 0
+
+            if not cfg.train_fast:
+                prec1 = (self.validate(state, alg, val_loader)
+                         if val_loader is not None else -1.0)
+                final_prec1 = prec1
+                self._log_val_row(epoch, meters, prec1)
+                is_best = prec1 > best_prec1
+                best_prec1 = max(best_prec1, prec1)
+                if self.cluster is not None:
+                    meta = {
+                        "epoch": epoch + 1, "itr": 0,
+                        "best_prec1": float(best_prec1),
+                        "elapsed_time": time.time() - begin_time,
+                        "batch_meter": batch_meter.state_dict(),
+                        "nn_meter": nn_meter.state_dict(),
+                        "data_meter": data_meter.state_dict(),
+                    }
+                    epoch_id = (None if cfg.overwrite_checkpoints else epoch)
+                    self.cluster.save_checkpoint(
+                        state, meta, epoch_id=epoch_id, is_best=is_best,
+                        requeue_on_signal=(epoch != cfg.num_epochs - 1))
+
+        if cfg.train_fast and val_loader is not None:
+            alg = self._train_fn(
+                ppi_at_epoch(cfg.ppi_schedule, cfg.num_epochs - 1)
+                if not cfg.all_reduce else 1, itr_per_epoch)[0]
+            final_prec1 = self.validate(state, alg, val_loader)
+            self.log.info(f"Test accuracy: {final_prec1}")
+
+        return state, {"best_prec1": float(best_prec1),
+                       "final_prec1": float(final_prec1),
+                       "elapsed_time": time.time() - begin_time,
+                       "batch_meter": batch_meter}
+
+    def _train_epoch(self, state, train_fn, loader, epoch, start_itr,
+                     meters):
+        cfg = self.cfg
+        batch_meter, nn_meter, data_meter = meters
+        losses = Meter(ptag="Loss")
+        top1 = Meter(ptag="Prec@1")
+        top5 = Meter(ptag="Prec@5")
+        num_itr_ignore = cfg.num_itr_ignore
+
+        if start_itr:
+            loader.fast_forward(start_itr)
+
+        batch_time = time.time()
+        i = start_itr - 1
+        for i, (x, y) in enumerate(iter(loader), start=start_itr):
+            if num_itr_ignore == 0:
+                data_meter.update(time.time() - batch_time)
+
+            nn_time = time.time()
+            state, metrics = train_fn(state, x, y)
+            jax.block_until_ready(state)
+            if num_itr_ignore == 0:
+                nn_meter.update(time.time() - nn_time)
+                batch_meter.update(time.time() - batch_time)
+            batch_time = time.time()
+
+            n = x.shape[0] * x.shape[1]
+            losses.update(float(np.mean(metrics["loss"])), n)
+            top1.update(float(np.mean(metrics["top1"])), n)
+            top5.update(float(np.mean(metrics["top5"])), n)
+            if i % cfg.print_freq == 0:
+                self._log_row(epoch, i, meters, losses, top1, top5)
+            if num_itr_ignore > 0:
+                num_itr_ignore -= 1
+
+            if (cfg.num_iterations_per_training_epoch not in (None, -1)
+                    and i + 1 == cfg.num_iterations_per_training_epoch):
+                break
+
+        self._log_row(epoch, i, meters, losses, top1, top5)
+        return state
+
+    def validate(self, state, algorithm, val_loader) -> float:
+        """Every rank evaluates the full val set independently
+        (gossip_sgd.py:440-471); returns mean top-1 across ranks."""
+        if self._eval_fn is None:
+            eval_step = build_eval_step(self.model, algorithm,
+                                        self.cfg.num_classes)
+            self._eval_fn = shard_eval_step(
+                eval_step, self.mesh, self.gossip_axis, self.local_axis)
+        losses = Meter(ptag="Loss")
+        top1 = Meter(ptag="Prec@1")
+        top5 = Meter(ptag="Prec@5")
+        for x, y in val_loader:
+            m = self._eval_fn(state, x, y)
+            n = x.shape[0] * x.shape[1]
+            losses.update(float(np.mean(m["loss"])), n)
+            top1.update(float(np.mean(m["top1"])), n)
+            top5.update(float(np.mean(m["top5"])), n)
+        self.log.info(
+            f" * Prec@1 {top1.avg:.3f} Prec@5 {top5.avg:.3f}")
+        return top1.avg

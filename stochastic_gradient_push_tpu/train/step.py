@@ -73,7 +73,14 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             loss_fn, has_aux=True)(z)
 
         if local_axis is not None:
-            grads = jax.tree.map(lambda g: lax.pmean(g, local_axis), grads)
+            # exact intra-node averaging of gradients and BN statistics
+            # (≙ the local all-reduce group, distributed.py:551-562, and BN
+            # buffer sync :269-276).  Params are *invariant* over the local
+            # axis (sharded over the node axis only), so autodiff already
+            # psums grads over local devices — divide by the axis size to
+            # turn that sum into the mean.
+            n_local = lax.axis_size(local_axis)
+            grads = jax.tree.map(lambda g: g / n_local, grads)
             batch_stats = jax.tree.map(
                 lambda b: lax.pmean(b, local_axis), batch_stats)
         grads = algorithm.reduce_grads(grads)
@@ -91,6 +98,9 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
         top1, top5 = accuracy_topk(logits, labels, topk=(1, 5))
         metrics = {"loss": loss, "top1": top1, "top5": top5, "lr": lr}
+        if local_axis is not None:
+            metrics = jax.tree.map(
+                lambda m: lax.pmean(m, local_axis), metrics)
         new_state = state.replace(
             step=state.step + 1, params=params, batch_stats=batch_stats,
             opt_state=opt_state, gossip=gstate)
@@ -117,15 +127,24 @@ def build_eval_step(model, algorithm: GossipAlgorithm,
     return eval_step
 
 
-def shard_train_step(step_fn, mesh, axis_name: str = GOSSIP_AXIS):
-    """Wrap a per-rank step for a 1-D gossip mesh.
+def shard_train_step(step_fn, mesh, axis_name: str = GOSSIP_AXIS,
+                     local_axis: str | None = None):
+    """Wrap a per-rank step for a gossip mesh.
 
-    Globally, every input/output leaf carries a leading world dimension
-    sharded over ``axis_name`` (each rank = one model replica + one batch
-    shard); the per-shard leading axis of size 1 is squeezed away before the
-    per-rank step runs and restored after, so ``step_fn`` is written in
-    plain single-rank terms.
+    Globally, every state leaf carries a leading gossip-rank dimension
+    sharded over ``axis_name`` (each rank = one model replica); batches
+    carry a leading dimension covering *all* devices.  The per-shard leading
+    axis of size 1 is squeezed away before the per-rank step runs and
+    restored after, so ``step_fn`` is written in plain single-rank terms.
+
+    With ``local_axis`` (hierarchical ``(node, local)`` mesh,
+    ≙ nprocs_per_node, distributed.py:62-78): batches shard over both axes
+    (one shard per device), while state shards over the node axis only —
+    the step's intra-node ``pmean`` keeps local replicas identical, which is
+    what makes the node-only state sharding valid.
     """
+    batch_spec = (P(axis_name) if local_axis is None
+                  else P((axis_name, local_axis)))
 
     def wrapped(state, images, labels):
         squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
@@ -136,24 +155,30 @@ def shard_train_step(step_fn, mesh, axis_name: str = GOSSIP_AXIS):
 
     sharded = jax.shard_map(
         wrapped, mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(P(axis_name), batch_spec, batch_spec),
         out_specs=(P(axis_name), P(axis_name)))
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def shard_eval_step(eval_fn, mesh, axis_name: str = GOSSIP_AXIS):
-    """Wrap a per-rank eval step for a 1-D gossip mesh (see
+def shard_eval_step(eval_fn, mesh, axis_name: str = GOSSIP_AXIS,
+                    local_axis: str | None = None):
+    """Wrap a per-rank eval step for a gossip mesh (see
     :func:`shard_train_step`); returns per-rank metrics stacked over the
-    world dimension."""
+    gossip dimension."""
+    batch_spec = (P(axis_name) if local_axis is None
+                  else P((axis_name, local_axis)))
 
     def wrapped(state, images, labels):
         squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
         metrics = eval_fn(squeeze(state), squeeze(images), squeeze(labels))
+        if local_axis is not None:
+            metrics = jax.tree.map(
+                lambda m: jax.lax.pmean(m, local_axis), metrics)
         return jax.tree.map(lambda a: a[None], metrics)
 
     sharded = jax.shard_map(
         wrapped, mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(P(axis_name), batch_spec, batch_spec),
         out_specs=P(axis_name))
     return jax.jit(sharded)
 

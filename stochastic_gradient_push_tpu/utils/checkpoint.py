@@ -1,0 +1,173 @@
+"""Checkpointing and preemption handling.
+
+Ports the reference's ``ClusterManager`` (experiment_utils/cluster_manager.py)
+and gossip-aware ``state_dict`` semantics (distributed.py:209-229,
+gossip_sgd.py:306-315) to the explicit-state world:
+
+* **Per-rank checkpoints** — decentralized algorithms have *different* models
+  on every rank, so each rank's replica is saved
+  (``checkpoint_r{rank}_n{world}.ckpt``, ≙ cluster_manager.py:62-78 with
+  ``--checkpoint_all``).  The stacked :class:`TrainState` already carries the
+  full push-sum weight and in-flight buffers, so nothing the reference's
+  ``state_dict`` special-cases (ps_weight, is_ps_numerator) can be lost —
+  there is no in-flight gossip outside the state to drain.
+* **Best-model copies** on validation improvement (cluster_manager.py:100-103).
+* **Preemption**: SIGUSR1/SIGTERM handlers set a flag; the flag is shared
+  via the filesystem rather than an all-reduce (cluster_manager.py:88-89)
+  since a TPU pod's hosts all see the coordinator decision; on requeue
+  request, the manager invokes a user-supplied relaunch command
+  (``scontrol requeue`` under SLURM, ≙ cluster_manager.py:105-118).
+
+Serialization uses ``flax.serialization`` msgpack over the raw state pytree
+plus a JSON metadata sidecar (epoch, itr, meters, best metric).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import typing as tp
+
+import flax.serialization
+import jax
+import numpy as np
+
+from .logging import make_logger
+
+__all__ = ["CheckpointManager", "ClusterManager"]
+
+
+class CheckpointManager:
+    """Save/restore world-stacked train state + host metadata."""
+
+    def __init__(self, directory: str, tag: str = "", rank: int = 0,
+                 world_size: int = 1, all_workers: bool = True):
+        self.directory = directory
+        self.tag = tag
+        self.rank = rank if all_workers else 0
+        self.world_size = world_size
+        os.makedirs(directory, exist_ok=True)
+        base = f"{tag}checkpoint_r{self.rank}_n{world_size}"
+        self.checkpoint_path = os.path.join(directory, base + ".ckpt")
+        self.best_path = os.path.join(
+            directory, f"{tag}model_best_r{self.rank}_n{world_size}.ckpt")
+
+    def path_for_epoch(self, epoch_id: int | None) -> str:
+        """Unique-per-epoch file unless overwriting (gossip_sgd.py:333-336)."""
+        if epoch_id is None:
+            return self.checkpoint_path
+        return os.path.join(
+            os.path.dirname(self.checkpoint_path),
+            f"ep{epoch_id}_" + os.path.basename(self.checkpoint_path))
+
+    def save(self, state, meta: dict, epoch_id: int | None = None,
+             is_best: bool = False) -> str:
+        path = self.path_for_epoch(epoch_id)
+        state = jax.tree.map(np.asarray, state)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(flax.serialization.to_bytes(state))
+        os.replace(tmp, path)
+        # meta is written atomically too: a crash between the two writes must
+        # not pair a new checkpoint with the previous epoch's metadata
+        meta_tmp = path + ".meta.json.tmp"
+        with open(meta_tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(meta_tmp, path + ".meta.json")
+        if path != self.checkpoint_path:
+            # keep the canonical resume path pointing at the newest save
+            shutil.copyfile(path, self.checkpoint_path)
+            shutil.copyfile(path + ".meta.json",
+                            self.checkpoint_path + ".meta.json")
+        if is_best:
+            shutil.copyfile(path, self.best_path)
+        return path
+
+    def exists(self) -> bool:
+        return os.path.isfile(self.checkpoint_path)
+
+    def restore(self, state_template) -> tuple[tp.Any, dict]:
+        """Restore into the structure of ``state_template``."""
+        with open(self.checkpoint_path, "rb") as f:
+            state = flax.serialization.from_bytes(state_template, f.read())
+        meta_path = self.checkpoint_path + ".meta.json"
+        meta = {}
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        return state, meta
+
+
+class ClusterManager:
+    """Signal-aware checkpoint coordinator (≙ cluster_manager.py:24-141)."""
+
+    def __init__(self, checkpoint_manager: CheckpointManager,
+                 rank: int = 0,
+                 requeue_command: str | None = None,
+                 install_handlers: bool = True):
+        self.ckpt = checkpoint_manager
+        self.rank = rank
+        self.requeue_command = requeue_command
+        self.signal_received = False
+        self.logger = make_logger(rank)
+        self._flag_path = os.path.join(
+            self.ckpt.directory, f"{self.ckpt.tag}.preempt_flag")
+        # a stale flag from a killed run must not make the requeued job
+        # checkpoint-and-exit again after its first epoch
+        if rank == 0:
+            try:
+                os.remove(self._flag_path)
+            except OSError:
+                pass
+        if install_handlers:
+            self.install_signal_handlers()
+
+    # -- signals -----------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        signal.signal(signal.SIGUSR1, self._sigusr1)
+        signal.signal(signal.SIGTERM, self._sigterm)
+        self.logger.info("Signal handlers installed")
+
+    def _sigterm(self, signum, frame):
+        # SIGTERM is advisory under SLURM preemption; SIGUSR1 does the work
+        # (cluster_manager.py:126-131)
+        self.logger.info("Received SIGTERM")
+
+    def _sigusr1(self, signum, frame):
+        self.logger.info("Received SIGUSR1")
+        self.signal_received = True
+        try:
+            with open(self._flag_path, "w") as f:
+                f.write("1")
+        except OSError as e:
+            self.logger.warning(f"could not write preempt flag: {e}")
+
+    def any_rank_signalled(self) -> bool:
+        """Filesystem analogue of the signal all-reduce
+        (cluster_manager.py:88-89): every host sees the shared flag file."""
+        return self.signal_received or os.path.isfile(self._flag_path)
+
+    # -- checkpoint + requeue ---------------------------------------------
+
+    def save_checkpoint(self, state, meta: dict, epoch_id: int | None = None,
+                        is_best: bool = False,
+                        requeue_on_signal: bool = True) -> None:
+        self.logger.info("Saving checkpoint")
+        self.ckpt.save(state, meta, epoch_id=epoch_id, is_best=is_best)
+
+        if requeue_on_signal and self.any_rank_signalled():
+            self.logger.info(
+                "At least 1 process received SIGUSR1. Terminating")
+            if self.rank == 0 and self.requeue_command:
+                self.logger.info("Relaunching: " + self.requeue_command)
+                if os.system(self.requeue_command):
+                    raise RuntimeError("requeue command failed")
+                self.logger.info("New job submitted to the queue")
+            try:
+                os.remove(self._flag_path)
+            except OSError:
+                pass
+            raise SystemExit(0)

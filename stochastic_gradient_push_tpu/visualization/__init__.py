@@ -1,0 +1,19 @@
+"""Log parsing and figure generation."""
+
+from .plotting import (
+    ITERATIONS_PER_EPOCH,
+    parse_csv,
+    parse_transformer_out,
+    plot_itrs,
+    plot_scaling,
+    plot_transformer,
+)
+
+__all__ = [
+    "ITERATIONS_PER_EPOCH",
+    "parse_csv",
+    "parse_transformer_out",
+    "plot_itrs",
+    "plot_scaling",
+    "plot_transformer",
+]

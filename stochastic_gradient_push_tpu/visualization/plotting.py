@@ -1,0 +1,141 @@
+"""Post-hoc log parsing and paper-style figures.
+
+Port of ``visualization/plotting.py`` (reference :26-362): parses the
+per-rank CSV logs the trainer emits (identical schema, so logs from either
+implementation parse here) and produces the paper's figure families —
+train/val error vs wall-clock time, time-per-iteration scaling across node
+counts, and transformer NLL curves from fairseq-style logs.
+
+Matplotlib is imported lazily with the Agg backend so the module works on
+headless TPU hosts.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["parse_csv", "parse_transformer_out", "plot_itrs",
+           "plot_scaling", "plot_transformer", "ITERATIONS_PER_EPOCH"]
+
+# iterations per epoch at batch 256/node on ImageNet
+# (≙ plotting.py:196-197)
+ITERATIONS_PER_EPOCH = {4: 1251, 8: 625, 16: 312, 32: 156}
+
+
+def _plt():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def parse_csv(fpath: str) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """Parse one rank's training CSV into (train_rows, val_rows).
+
+    ≙ plotting.py:195-228: skips the 4 preamble lines, splits on the
+    ``itr == -1`` validation marker rows, and reconstructs elapsed time from
+    the cumulative batch-time average.
+    """
+    df = pd.read_csv(fpath, skiprows=4)
+    df.columns = [c.strip() for c in df.columns]
+    val = df[df["itr"] == -1].copy()
+    train = df[df["itr"] != -1].copy()
+    # elapsed wall-clock estimate: cumulative mean batch time × global
+    # iteration number (the itr column is sampled every print_freq rows, so
+    # use the logged iteration numbers, not row indices)
+    itr_per_epoch = train["itr"].max() + 1
+    train["elapsed"] = train["avg:BT(s)"] * (
+        train["Epoch"] * itr_per_epoch + train["itr"] + 1)
+    return train, val
+
+
+def _gather_rank_files(directory: str, world_size: int,
+                       tag: str = "") -> list[str]:
+    files = []
+    for rank in range(world_size):
+        f = os.path.join(directory, f"{tag}out_r{rank}_n{world_size}.csv")
+        if os.path.isfile(f):
+            files.append(f)
+    return files
+
+
+def plot_itrs(directory: str, world_size: int, tag: str = "",
+              out_path: str | None = None, metric: str = "avg:Loss"):
+    """Training metric vs iteration for every rank (≙ plotting.py:255-292)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for f in _gather_rank_files(directory, world_size, tag):
+        train, _ = parse_csv(f)
+        rank = re.search(r"out_r(\d+)_", f).group(1)
+        x = train["Epoch"] * (train["itr"].max() + 1) + train["itr"]
+        ax.plot(x, train[metric], alpha=0.6, label=f"rank {rank}")
+    ax.set_xlabel("iteration")
+    ax.set_ylabel(metric)
+    ax.legend(fontsize=7, ncol=4)
+    if out_path:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    return fig
+
+
+def plot_scaling(results: dict[int, float], baseline: dict[int, float]
+                 | None = None, out_path: str | None = None,
+                 ylabel: str = "time per iteration (s)"):
+    """Time-per-iteration across node counts (≙ plotting.py:295-343).
+
+    ``results``/``baseline``: {num_nodes: time_per_itr}.
+    """
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(6, 4))
+    nodes = sorted(results)
+    ax.plot(nodes, [results[n] for n in nodes], "o-", label="SGP")
+    if baseline:
+        bn = sorted(baseline)
+        ax.plot(bn, [baseline[n] for n in bn], "s--", label="AR")
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(nodes)
+    ax.set_xticklabels(nodes)
+    ax.set_xlabel("nodes")
+    ax.set_ylabel(ylabel)
+    ax.legend()
+    if out_path:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    return fig
+
+
+_TRANSFORMER_RE = re.compile(
+    r"epoch (\d+).*?loss ([\d.]+).*?wall ([\d.]+)")
+
+
+def parse_transformer_out(fpath: str) -> pd.DataFrame:
+    """Parse fairseq-style transformer logs (≙ plotting.py:137-192):
+    extracts (epoch, loss, wall) triples from train-summary lines."""
+    rows = []
+    with open(fpath) as f:
+        for line in f:
+            m = _TRANSFORMER_RE.search(line)
+            if m:
+                rows.append({"epoch": int(m.group(1)),
+                             "loss": float(m.group(2)),
+                             "wall": float(m.group(3))})
+    return pd.DataFrame(rows)
+
+
+def plot_transformer(fpaths: dict[str, str], out_path: str | None = None):
+    """NLL vs wall-clock for labelled transformer runs
+    (≙ plotting.py:231-252)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for label, fpath in fpaths.items():
+        df = parse_transformer_out(fpath)
+        if len(df):
+            ax.plot(df["wall"] / 3600.0, df["loss"], label=label)
+    ax.set_xlabel("wall time (h)")
+    ax.set_ylabel("NLL")
+    ax.legend()
+    if out_path:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    return fig

@@ -1,0 +1,166 @@
+"""Run-layer tests: checkpointing, preemption manager, CLI, visualization."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.utils.checkpoint import (
+    CheckpointManager,
+    ClusterManager,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _state():
+    return {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+            "ps_weight": jnp.ones((4, 1))}
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cm = CheckpointManager(str(tmp_path), tag="t_", rank=0, world_size=4)
+    state = _state()
+    cm.save(state, {"epoch": 3, "itr": 7}, is_best=True)
+    assert cm.exists()
+    template = {"params": {"w": jnp.zeros((2, 3))},
+                "ps_weight": jnp.zeros((4, 1))}
+    restored, meta = cm.restore(template)
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    assert meta == {"epoch": 3, "itr": 7}
+    assert os.path.isfile(cm.best_path)
+
+
+def test_checkpoint_epoch_files_update_canonical(tmp_path):
+    cm = CheckpointManager(str(tmp_path), world_size=2)
+    cm.save(_state(), {"epoch": 1}, epoch_id=0)
+    cm.save(_state(), {"epoch": 2}, epoch_id=1)
+    # unique per-epoch files exist AND the canonical resume path tracks them
+    assert os.path.isfile(cm.path_for_epoch(0))
+    assert os.path.isfile(cm.path_for_epoch(1))
+    _, meta = cm.restore(_state())
+    assert meta["epoch"] == 2
+
+
+def test_cluster_manager_preemption_flow(tmp_path):
+    cm = CheckpointManager(str(tmp_path), world_size=2)
+    marker = tmp_path / "requeued"
+    cluster = ClusterManager(cm, rank=0,
+                             requeue_command=f"touch {marker}",
+                             install_handlers=False)
+    # no signal → normal save
+    cluster.save_checkpoint(_state(), {"epoch": 0})
+    assert not marker.exists()
+    # simulate SIGUSR1 → checkpoint, requeue, exit
+    cluster._sigusr1(signal.SIGUSR1, None)
+    with pytest.raises(SystemExit):
+        cluster.save_checkpoint(_state(), {"epoch": 1})
+    assert marker.exists()
+    # flag file cleaned up afterwards
+    assert not os.path.isfile(cluster._flag_path)
+
+
+def test_cluster_manager_flag_is_shared_via_fs(tmp_path):
+    cm1 = CheckpointManager(str(tmp_path), world_size=2)
+    a = ClusterManager(cm1, rank=0, install_handlers=False)
+    b = ClusterManager(cm1, rank=1, install_handlers=False)
+    assert not b.any_rank_signalled()
+    a._sigusr1(signal.SIGUSR1, None)
+    # the other "rank" observes the preemption via the shared flag file
+    assert b.any_rank_signalled()
+
+
+CLI_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+
+def _run_cli(module, tmp_path, extra=(), timeout=420):
+    cmd = [sys.executable, "-m", module,
+           "--dataset", "synthetic", "--world_size", "8",
+           "--model", "tiny_cnn", "--num_classes", "4",
+           "--image_size", "8", "--batch_size", "4",
+           "--num_epochs", "1", "--num_itr_ignore", "0",
+           "--num_iterations_per_training_epoch", "3",
+           "--checkpoint_dir", str(tmp_path) + "/", *extra]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=CLI_ENV)
+
+
+@pytest.mark.slow
+def test_cli_end_to_end_produces_csv_and_checkpoint(tmp_path):
+    r = _run_cli("stochastic_gradient_push_tpu.run.gossip_sgd", tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    csv = tmp_path / "out_r0_n8.csv"
+    assert csv.exists()
+    lines = csv.read_text().splitlines()
+    assert lines[0] == "BEGIN-TRAINING"
+    assert lines[4].startswith("Epoch,itr,BT(s),avg:BT(s),std:BT(s),")
+    assert any(line.split(",")[1] == "-1" for line in lines[5:])  # val row
+    assert (tmp_path / "checkpoint_r0_n8.ckpt").exists()
+    meta = json.loads((tmp_path / "checkpoint_r0_n8.ckpt.meta.json")
+                      .read_text())
+    assert meta["epoch"] == 1
+
+
+@pytest.mark.slow
+def test_cli_all_reduce_baseline(tmp_path):
+    r = _run_cli("stochastic_gradient_push_tpu.run.gossip_sgd", tmp_path,
+                 extra=("--all_reduce", "True", "--graph_type", "-1"))
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_cli_adpsgd(tmp_path):
+    r = _run_cli("stochastic_gradient_push_tpu.run.gossip_sgd_adpsgd",
+                 tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_cli_rejects_inconsistent_flags(tmp_path):
+    from stochastic_gradient_push_tpu.run.gossip_sgd import parse_config
+    with pytest.raises(SystemExit):
+        parse_config(["--all_reduce", "True", "--graph_type", "5"])
+    with pytest.raises(SystemExit):
+        parse_config(["--peers_per_itr_schedule", "5", "2"])
+
+
+def test_parse_pair_schedules():
+    from stochastic_gradient_push_tpu.run.gossip_sgd import parse_config
+    cfg, _ = parse_config([
+        "--schedule", "30", "0.1", "60", "0.1", "80", "0.1",
+        "--peers_per_itr_schedule", "0", "1", "10", "2"])
+    assert cfg.lr_schedule == {30: 0.1, 60: 0.1, 80: 0.1}
+    assert cfg.ppi_schedule == {0: 1, 10: 2}
+
+
+def test_visualization_parses_trainer_csv(tmp_path):
+    from stochastic_gradient_push_tpu.visualization import (
+        parse_csv, plot_itrs)
+    f = tmp_path / "out_r0_n8.csv"
+    f.write_text(
+        "BEGIN-TRAINING\nWorld-Size,8\nNum-DLWorkers,0\nBatch-Size,8\n"
+        "Epoch,itr,BT(s),avg:BT(s),std:BT(s),NT(s),avg:NT(s),std:NT(s),"
+        "DT(s),avg:DT(s),std:DT(s),Loss,avg:Loss,Prec@1,avg:Prec@1,"
+        "Prec@5,avg:Prec@5,val\n"
+        "0,0,0.1,0.1,0.0,0.08,0.08,0.0,0.01,0.01,0.0,"
+        "2.0,2.0,10.0,10.0,50.0,50.0,-1\n"
+        "0,10,0.1,0.1,0.0,0.08,0.08,0.0,0.01,0.01,0.0,"
+        "1.5,1.7,20.0,15.0,60.0,55.0,-1\n"
+        "0,-1,0.1,0.1,0.0,0.08,0.08,0.0,0.01,0.01,0.0,"
+        "-1,-1,-1,-1,-1,-1,42.5\n")
+    train, val = parse_csv(str(f))
+    assert len(train) == 2 and len(val) == 1
+    assert float(val["val"].iloc[0]) == 42.5
+    fig = plot_itrs(str(tmp_path), world_size=8, out_path=str(
+        tmp_path / "fig.png"))
+    assert (tmp_path / "fig.png").exists()
