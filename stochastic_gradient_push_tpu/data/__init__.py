@@ -1,5 +1,6 @@
 """Input pipelines."""
 
+from .lm import lm_batches, synthetic_lm_corpus
 from .pipeline import (
     DistributedSampler,
     ShardedLoader,
@@ -12,4 +13,6 @@ __all__ = [
     "ShardedLoader",
     "synthetic_classification",
     "imagefolder_arrays",
+    "synthetic_lm_corpus",
+    "lm_batches",
 ]

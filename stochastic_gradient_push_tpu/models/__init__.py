@@ -10,6 +10,7 @@ from .resnet import (
     resnet152,
 )
 from .small import TinyCNN, TinyMLP
+from .transformer import TransformerConfig, TransformerLM
 
 __all__ = [
     "ResNet",
@@ -21,4 +22,6 @@ __all__ = [
     "resnet152",
     "TinyCNN",
     "TinyMLP",
+    "TransformerLM",
+    "TransformerConfig",
 ]

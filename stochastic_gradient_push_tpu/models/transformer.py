@@ -1,0 +1,162 @@
+"""Decoder-only transformer LM with optional ring-attention sequence
+parallelism.
+
+The reference's transformer experiments (WMT16, paper §5) ran in an external
+fairseq fork — the repo itself ships only the log parser
+(visualization/plotting.py:137-192).  This module makes the transformer a
+first-class in-repo model family, built TPU-first:
+
+* pre-norm blocks, bf16-friendly compute with fp32 LN/softmax
+* rotary position embeddings (no learned position table to shard)
+* attention backends: ``full`` (plain causal), ``blockwise``
+  (O(block²) memory, single device), or ``ring`` — exact attention over a
+  sequence-sharded mesh axis (parallel/ring_attention.py), with every rank
+  holding ``seq/world`` tokens
+* pointwise sublayers (embedding, LN, MLP, logits) act per-token, so under
+  sequence sharding they need no communication at all
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.ring_attention import blockwise_attention, ring_attention
+
+__all__ = ["TransformerLM", "TransformerConfig"]
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray,
+          base: float = 10000.0) -> jnp.ndarray:
+    """Rotary embeddings. x: [B, H, T, D]; positions: [T] global indices."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, None]      # [1,1,T,half]
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+class TransformerConfig(tp.NamedTuple):
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_len: int = 2048
+    dtype: tp.Any = jnp.float32
+    attn_impl: str = "full"           # full | blockwise | flash | ring
+    attn_block_size: int = 128        # for blockwise
+    seq_axis: str | None = None       # mesh axis for ring attention
+    remat: bool = False               # jax.checkpoint each block
+
+
+class _Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.n_heads
+        dense = lambda name: nn.Dense(
+            cfg.d_model, use_bias=False, dtype=cfg.dtype, name=name)
+        q = dense("q")(x)
+        k = dense("k")(x)
+        v = dense("v")(x)
+
+        def split(t):  # [B,T,E] → [B,H,T,D]
+            b, s, _ = t.shape
+            return t.reshape(b, s, cfg.n_heads, head_dim).transpose(
+                0, 2, 1, 3)
+
+        q, k, v = split(q), split(k), split(v)
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+
+        if cfg.attn_impl == "ring":
+            if cfg.seq_axis is None:
+                raise ValueError("ring attention requires seq_axis")
+            out = ring_attention(q, k, v, cfg.seq_axis, causal=True)
+        elif cfg.attn_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True,
+                                  block_q=cfg.attn_block_size,
+                                  block_k=cfg.attn_block_size)
+        elif cfg.attn_impl == "blockwise":
+            out = blockwise_attention(q, k, v, cfg.attn_block_size,
+                                      causal=True)
+        elif cfg.attn_impl == "full":
+            t = q.shape[2]
+            mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s * head_dim ** -0.5
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                             v.astype(jnp.float32)).astype(cfg.dtype)
+        else:
+            raise ValueError(f"unknown attn_impl {cfg.attn_impl}")
+
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="o")(out)
+
+
+class _Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        x = x + _Attention(cfg, name="attn")(ln("ln1")(x), positions)
+        h = ln("ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM.  ``__call__(tokens, train)`` → logits ``[B, T, vocab]``.
+
+    Under sequence sharding (``attn_impl='ring'``), ``tokens`` is this
+    rank's contiguous block and global positions are derived from the
+    rank's position on the sequence axis.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        del train  # no dropout in the base recipe
+        cfg = self.cfg
+        b, t = tokens.shape
+        if cfg.attn_impl == "ring":
+            offset = lax.axis_index(cfg.seq_axis) * t
+        else:
+            offset = 0
+        positions = offset + jnp.arange(t)
+
+        x = nn.Embed(cfg.vocab_size, cfg.d_model,
+                     embedding_init=nn.initializers.normal(0.02),
+                     dtype=cfg.dtype, name="embed")(tokens)
+        block = _Block
+        if cfg.remat:
+            block = nn.remat(_Block)
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block_{i}")(x, positions)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=cfg.dtype, name="lm_head")(x)
+        return jnp.asarray(logits, jnp.float32)

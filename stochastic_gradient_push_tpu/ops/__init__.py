@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for hot ops."""
+
+from .flash_attention import flash_attention, flash_attention_forward
+
+__all__ = ["flash_attention", "flash_attention_forward"]

@@ -7,6 +7,8 @@ from .mesh import (
     make_gossip_mesh,
     make_hierarchical_mesh,
 )
+from .discovery import ClusterInfo, discover, initialize_multihost
+from .ring_attention import blockwise_attention, ring_attention
 from .collectives import (
     allreduce_mean,
     allreduce_sum,
@@ -22,10 +24,15 @@ __all__ = [
     "LOCAL_AXIS",
     "make_gossip_mesh",
     "make_hierarchical_mesh",
+    "ClusterInfo",
+    "discover",
+    "initialize_multihost",
     "gossip_round",
     "mix_push_sum",
     "mix_push_pull",
     "mix_bilat",
     "allreduce_mean",
     "allreduce_sum",
+    "ring_attention",
+    "blockwise_attention",
 ]

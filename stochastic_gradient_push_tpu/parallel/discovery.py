@@ -1,0 +1,98 @@
+"""Cluster discovery and multi-host initialization.
+
+The TPU counterpart of the reference's bootstrap plumbing: NIC selection
+(experiment_utils/helpers.py:44-67 → ``NCCL_SOCKET_IFNAME``), SLURM/MPI
+env-var rank discovery (gossip_sgd.py:586-605), and
+``dist.init_process_group`` (gossip_sgd.py:671-673).  On TPU none of that
+involves sockets or NICs: device topology comes from the platform, and
+multi-host rendezvous is ``jax.distributed.initialize`` (driven by the TPU
+metadata service on Cloud TPU, or by the same SLURM variables elsewhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+__all__ = ["ClusterInfo", "discover", "initialize_multihost"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterInfo:
+    """What the launch layer needs to know about where it's running."""
+
+    platform: str
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    device_kind: str
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.process_count > 1
+
+
+def discover() -> ClusterInfo:
+    """Inspect the runtime (after optional :func:`initialize_multihost`)."""
+    devices = jax.devices()
+    return ClusterInfo(
+        platform=devices[0].platform,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=len(devices),
+        device_kind=devices[0].device_kind,
+    )
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Join a multi-host cluster (≙ ``dist.init_process_group``).
+
+    With no arguments, relies on the platform's auto-detection (Cloud TPU
+    metadata).  Under SLURM, reads the same env vars the reference does
+    (SLURM_PROCID / SLURM_NTASKS, gossip_sgd.py:604-605) and derives the
+    coordinator from the first node in the job's node list.
+    """
+    if (coordinator_address is None and process_id is None
+            and "SLURM_PROCID" in os.environ):
+        process_id = int(os.environ["SLURM_PROCID"])
+        num_processes = int(os.environ["SLURM_NTASKS"])
+        nodelist = os.environ.get("SLURM_JOB_NODELIST", "")
+        head = (_first_slurm_host(nodelist) if nodelist
+                else os.environ.get("HOSTNAME", "localhost"))
+        port = os.environ.get("COORDINATOR_PORT", "40100")
+        coordinator_address = f"{head}:{port}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def _first_slurm_host(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist.
+
+    Handles dashes in hostnames and bracket ranges:
+    ``tpu-pod-[003-007,010]`` → ``tpu-pod-003``; ``a-1,b-2`` → ``a-1``.
+    Prefers ``scontrol show hostnames`` when available (authoritative).
+    """
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["scontrol", "show", "hostnames", nodelist],
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.split()[0]
+    except (OSError, subprocess.SubprocessError):
+        pass
+    bracket = nodelist.find("[")
+    if bracket == -1:
+        return nodelist.split(",")[0]
+    prefix = nodelist[:bracket]
+    inside = nodelist[bracket + 1:nodelist.index("]", bracket)]
+    first = inside.split(",")[0].split("-")[0]
+    return prefix + first

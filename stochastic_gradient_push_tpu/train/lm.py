@@ -1,0 +1,125 @@
+"""Language-model training: gossip data parallelism × ring-attention
+sequence parallelism on one 2-D mesh.
+
+Composes the decentralized algorithms with long-context support: the mesh
+is ``(gossip, seq)`` — model replicas gossip over the first axis exactly as
+in image training, while each replica's sequence is sharded over the second
+axis and attention runs as a ring (parallel/ring_attention.py).  The
+reference has no counterpart (its transformer runs lived in an external
+fairseq fork, SURVEY.md §5); this is the TPU-native extension the task
+treats as first-class.
+
+Sharding contract:
+  * state: leading gossip dimension, replicated over ``seq``
+    (pointwise sublayers need the full parameters; autodiff therefore
+    psums gradients over ``seq`` and the step divides by the axis size)
+  * tokens/targets: leading ``(gossip, seq)`` dimensions, each seq shard
+    holding a contiguous block of every sequence; targets are pre-shifted
+    globally by the data pipeline so no cross-shard shift is needed
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..algorithms.api import GossipAlgorithm
+from ..parallel.collectives import as_scalar
+from ..parallel.mesh import GOSSIP_AXIS
+from .state import TrainState
+
+SEQ_AXIS = "seq"
+
+__all__ = ["SEQ_AXIS", "make_dp_sp_mesh", "build_lm_train_step",
+           "shard_lm_train_step", "lm_loss"]
+
+
+def make_dp_sp_mesh(dp: int, sp: int, devices=None) -> Mesh:
+    """2-D ``(gossip, seq)`` mesh: dp model replicas × sp sequence shards."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < dp * sp:
+        raise ValueError(f"need {dp * sp} devices, have {len(devices)}")
+    grid = np.asarray(devices[:dp * sp]).reshape(dp, sp)
+    return Mesh(grid, (GOSSIP_AXIS, SEQ_AXIS))
+
+
+def lm_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy over the local block."""
+    logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
+                        itr_per_epoch: int,
+                        seq_axis: str | None = SEQ_AXIS) -> tp.Callable:
+    """Per-rank LM step ``(state, tokens, targets) -> (state, metrics)``.
+
+    Same four-slot structure as the image step (train/step.py); loss is
+    token-mean cross-entropy, and with sequence sharding the seq-psummed
+    gradients are renormalized to the global token mean.
+    """
+
+    def train_step(state: TrainState, tokens, targets):
+        params, gstate = algorithm.pre_step(state.params, state.gossip)
+        z = algorithm.eval_params(params, gstate)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, train=True)
+            return lm_loss(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(z)
+
+        if seq_axis is not None:
+            # params are invariant over seq → autodiff psums grads over the
+            # seq shards; divide to get the global token mean
+            n_seq = lax.axis_size(seq_axis)
+            grads = jax.tree.map(lambda g: g / n_seq, grads)
+            loss = lax.pmean(loss, seq_axis)
+        grads = algorithm.reduce_grads(grads)
+
+        step = as_scalar(state.step)
+        lr = lr_schedule(step // itr_per_epoch, step % itr_per_epoch,
+                         itr_per_epoch)
+        updates, opt_state = tx.update(grads, state.opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: p - lr.astype(p.dtype) * u, params, updates)
+        params, gstate = algorithm.post_step(params, gstate)
+
+        metrics = {"loss": loss, "ppl": jnp.exp(loss), "lr": lr}
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state, gossip=gstate), metrics
+
+    return train_step
+
+
+def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
+                        seq_axis: str | None = SEQ_AXIS):
+    """Wrap for the 2-D mesh: state stacks over gossip ranks; token batches
+    stack over ``(gossip, seq)``."""
+    if seq_axis is None:
+        batch_spec = P(gossip_axis)
+        squeeze_n = 1
+    else:
+        batch_spec = P(gossip_axis, seq_axis)
+        squeeze_n = 2
+
+    def wrapped(state, tokens, targets):
+        sq_state = jax.tree.map(lambda a: a[0], state)
+        sq = lambda t: jax.tree.map(
+            lambda a: a.reshape(a.shape[squeeze_n:]), t)
+        new_state, metrics = step_fn(sq_state, sq(tokens), sq(targets))
+        return (jax.tree.map(lambda a: a[None], new_state),
+                jax.tree.map(lambda a: a[None], metrics))
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(gossip_axis), batch_spec, batch_spec),
+        out_specs=(P(gossip_axis), P(gossip_axis)))
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -1,6 +1,25 @@
-"""Shared utilities: metering, logging."""
+"""Shared utilities: metering, logging, flattening, profiling."""
 
+from .flatten import (
+    communicate,
+    flatten_tensors,
+    global_norm,
+    group_by_dtype,
+    unflatten_tensors,
+)
 from .logging import make_logger
 from .meter import Meter
+from .profiling import HEARTBEAT_TIMEOUT, StepWatchdog, trace
 
-__all__ = ["Meter", "make_logger"]
+__all__ = [
+    "Meter",
+    "make_logger",
+    "flatten_tensors",
+    "unflatten_tensors",
+    "group_by_dtype",
+    "communicate",
+    "global_norm",
+    "StepWatchdog",
+    "trace",
+    "HEARTBEAT_TIMEOUT",
+]

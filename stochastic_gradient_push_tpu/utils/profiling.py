@@ -1,0 +1,83 @@
+"""Profiling and failure-detection utilities.
+
+The reference's observability is manual wall-clock meters (SURVEY.md §5
+"Tracing") and its failure detection is a 300-second heartbeat on the
+gossip thread's flag (distributed.py:36, :349-352).  Here:
+
+* :func:`trace` — ``jax.profiler`` trace context producing TensorBoard-
+  loadable XPlane dumps of the actual device timeline (compute/collective
+  overlap included), something the reference cannot see at all.
+* :class:`StepWatchdog` — heartbeat for the compiled step.  A hang inside
+  one XLA program can't happen the way a lost NCCL broadcast could, but a
+  multi-host collective CAN stall if a peer host dies; the watchdog logs
+  loudly (and optionally aborts) when a step exceeds the timeout — the
+  moral equivalent of the reference's ``Gossip flag timeout``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from .logging import make_logger
+
+__all__ = ["trace", "StepWatchdog", "HEARTBEAT_TIMEOUT"]
+
+HEARTBEAT_TIMEOUT = 300  # seconds, matching distributed.py:36
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed steps into ``log_dir`` (TensorBoard format)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepWatchdog:
+    """Wall-clock heartbeat around blocking step calls.
+
+    Usage::
+
+        wd = StepWatchdog(timeout=300)
+        with wd.step():
+            state, metrics = train_fn(state, x, y)
+            jax.block_until_ready(state)
+    """
+
+    def __init__(self, timeout: float = HEARTBEAT_TIMEOUT, rank: int = 0,
+                 abort_on_timeout: bool = False):
+        self.timeout = timeout
+        self.abort_on_timeout = abort_on_timeout
+        self.logger = make_logger(rank)
+        self.timed_out = False
+
+    @contextlib.contextmanager
+    def step(self):
+        fired = threading.Event()
+        start = time.monotonic()
+
+        def watch():
+            if not fired.wait(self.timeout):
+                self.timed_out = True
+                elapsed = time.monotonic() - start
+                self.logger.error(
+                    f"step exceeded heartbeat timeout "
+                    f"({elapsed:.0f}s > {self.timeout}s) — a peer host may "
+                    "be unreachable")
+                if self.abort_on_timeout:
+                    import os
+                    os._exit(70)
+
+        t = threading.Thread(target=watch, daemon=True,
+                             name="StepWatchdog")
+        t.start()
+        try:
+            yield
+        finally:
+            fired.set()

@@ -1,0 +1,104 @@
+"""Ring attention == full attention, sharded over a sequence mesh axis."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.parallel.ring_attention import (
+    blockwise_attention,
+    ring_attention,
+)
+
+WORLD = 8
+B, H, T, D = 2, 4, 64, 16  # T across all ranks; block = T // WORLD
+
+
+def full_attention(q, k, v, causal=False):
+    s = np.einsum("bhqd,bhkd->bhqk", q.astype(np.float64),
+                  k.astype(np.float64)) * (D ** -0.5)
+    if causal:
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v.astype(np.float64))
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    return [rng.normal(size=(B, H, T, D)).astype(np.float32)
+            for _ in range(3)]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from stochastic_gradient_push_tpu.parallel import make_gossip_mesh
+    return make_gossip_mesh(WORLD)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(mesh, qkv, causal):
+    q, k, v = qkv
+    block = T // WORLD
+
+    def shard_seq(x):
+        # [B,H,T,D] → [WORLD, B, H, block, D] (contiguous block layout)
+        return np.moveaxis(
+            x.reshape(B, H, WORLD, block, D), 2, 0).copy()
+
+    def f(qb, kb, vb):
+        return ring_attention(qb[0], kb[0], vb[0], "gossip",
+                              causal=causal)[None]
+
+    sharded = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P("gossip"), P("gossip"), P("gossip")),
+        out_specs=P("gossip")))
+    out_blocks = np.asarray(sharded(shard_seq(q), shard_seq(k),
+                                    shard_seq(v)))
+    # [WORLD, B, H, block, D] → [B, H, T, D]
+    got = np.moveaxis(out_blocks, 0, 2).reshape(B, H, T, D)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [8, 16, 64])
+def test_blockwise_attention_matches_full(qkv, causal, block):
+    q, k, v = qkv
+    got = np.asarray(jax.jit(
+        lambda q, k, v: blockwise_attention(q, k, v, block, causal=causal)
+    )(q, k, v))
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gradients_flow(mesh, qkv):
+    """Differentiability: ring attention participates in backprop."""
+    q, k, v = qkv
+    block = T // WORLD
+
+    def shard_seq(x):
+        return np.moveaxis(x.reshape(B, H, WORLD, block, D), 2, 0).copy()
+
+    def loss_fn(qb, kb, vb):
+        out = ring_attention(qb[0], kb[0], vb[0], "gossip", causal=True)
+        return jnp.sum(out ** 2)
+
+    def f(qb, kb, vb):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            qb, kb, vb)
+        return loss[None], grads
+
+    sharded = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P("gossip"), P("gossip"), P("gossip")),
+        out_specs=(P("gossip"), (P("gossip"), P("gossip"), P("gossip")))))
+    loss, grads = sharded(shard_seq(q), shard_seq(k), shard_seq(v))
+    for g in grads:
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g))
+        assert np.abs(g).max() > 0
