@@ -1,0 +1,228 @@
+"""Gossip LM CLI — decentralized transformer training with optional
+ring-attention sequence parallelism.
+
+The reference's transformer experiments lived in an external fairseq fork
+(its repo ships only the log parser, visualization/plotting.py:137-192);
+here the transformer path is a first-class CLI.  The mesh is
+``(gossip, seq)``: gossip data parallelism over ``--world_size // --sp``
+replicas composed with ``--sp``-way exact ring attention.
+
+Example (virtual 8-device CPU mesh, 4 replicas × 2 sequence shards):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python -m stochastic_gradient_push_tpu.run.gossip_lm \\
+      --world_size 8 --sp 2 --seq_len 64 --d_model 64 --n_layers 2 \\
+      --num_steps 100 --checkpoint_dir /tmp/lm/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from ..topology import GRAPH_TOPOLOGIES
+
+__all__ = ["main", "build_parser"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Gossip LM on TPU")
+    # algorithm (same registry/flags as gossip_sgd where applicable)
+    p.add_argument("--all_reduce", default="False", type=str)
+    p.add_argument("--push_sum", default="True", type=str)
+    p.add_argument("--overlap", default="False", type=str)
+    p.add_argument("--graph_type", default=5, type=int,
+                   choices=list(GRAPH_TOPOLOGIES))
+    p.add_argument("--peers_per_itr", default=1, type=int)
+    # optimization
+    p.add_argument("--lr", default=0.5, type=float)
+    p.add_argument("--momentum", default=0.9, type=float)
+    p.add_argument("--weight_decay", default=0.0, type=float)
+    p.add_argument("--nesterov", default="False", type=str)
+    p.add_argument("--warmup", default="False", type=str)
+    p.add_argument("--warmup_steps", default=None, type=int,
+                   help="linear warmup horizon (default: num_steps // 10)")
+    # model
+    p.add_argument("--vocab_size", default=256, type=int)
+    p.add_argument("--d_model", default=256, type=int)
+    p.add_argument("--n_layers", default=4, type=int)
+    p.add_argument("--n_heads", default=8, type=int)
+    p.add_argument("--d_ff", default=1024, type=int)
+    p.add_argument("--seq_len", default=256, type=int)
+    p.add_argument("--attn", default=None,
+                   choices=[None, "full", "blockwise", "flash", "ring"],
+                   help="default: ring when --sp > 1 else flash on TPU, "
+                        "full elsewhere")
+    p.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
+    p.add_argument("--remat", default="False", type=str)
+    # parallelism / run shape
+    p.add_argument("--world_size", default=None, type=int)
+    p.add_argument("--sp", default=1, type=int,
+                   help="sequence-parallel shards per replica")
+    p.add_argument("--batch_size", default=8, type=int,
+                   help="sequences per replica per step")
+    p.add_argument("--num_steps", default=1000, type=int)
+    p.add_argument("--print_freq", default=10, type=int)
+    p.add_argument("--seed", default=47, type=int)
+    p.add_argument("--corpus_tokens", default=500_000, type=int)
+    p.add_argument("--checkpoint_dir", default="./checkpoints", type=str)
+    p.add_argument("--tag", default="lm_", type=str)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..algorithms import all_reduce, dpsgd, sgp
+    from ..data.lm import lm_batches, synthetic_lm_corpus
+    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..parallel import GOSSIP_AXIS
+    from ..topology import build_schedule
+    from ..train import LRSchedule, replicate_state, sgd
+    from ..train.lm import (SEQ_AXIS, build_lm_train_step, make_dp_sp_mesh,
+                            shard_lm_train_step)
+    from ..train.lr import WARMUP_EPOCHS
+    from ..train.state import TrainState
+    from ..utils import Meter, make_logger
+    from .gossip_sgd import _str_bool as sb
+
+    log = make_logger("lm", True)
+
+    world = args.world_size or jax.device_count()
+    sp = args.sp
+    if world % sp:
+        raise SystemExit(f"world_size {world} not divisible by sp {sp}")
+    dp = world // sp
+    if args.seq_len % sp:
+        raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
+    mesh = make_dp_sp_mesh(dp, sp)
+
+    attn = args.attn
+    if attn is None:
+        attn = "ring" if sp > 1 else (
+            "flash" if jax.default_backend() == "tpu" else "full")
+    if sp > 1 and attn != "ring":
+        raise SystemExit("--sp > 1 requires ring attention")
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
+        max_len=args.seq_len,
+        dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+        attn_impl=attn, seq_axis=SEQ_AXIS if attn == "ring" else None,
+        remat=sb(args.remat))
+    model = TransformerLM(cfg)
+
+    if sb(args.all_reduce):
+        alg = all_reduce(GOSSIP_AXIS)
+    else:
+        graph = GRAPH_TOPOLOGIES[args.graph_type](
+            dp, peers_per_itr=args.peers_per_itr)
+        schedule = build_schedule(graph)
+        maker = sgp if sb(args.push_sum) else dpsgd
+        alg = maker(schedule, GOSSIP_AXIS, overlap=sb(args.overlap))
+
+    tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
+             nesterov=sb(args.nesterov))
+    # LR linear scaling counts data-parallel replicas (dp), not raw devices:
+    # sequence shards don't enlarge the global batch.  The warmup horizon is
+    # step-based (LRSchedule spans WARMUP_EPOCHS "epochs" of the synthetic
+    # itr_per_epoch below).
+    warmup_steps = args.warmup_steps or max(args.num_steps // 10, 1)
+    itr_per_epoch = max(warmup_steps // WARMUP_EPOCHS, 1)
+    lrs = LRSchedule(ref_lr=args.lr, batch_size=args.batch_size,
+                     world_size=dp, decay_schedule={},
+                     warmup=sb(args.warmup))
+    step = build_lm_train_step(
+        model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
+        seq_axis=SEQ_AXIS if attn == "ring" else None)
+    train_fn = shard_lm_train_step(
+        step, mesh, seq_axis=SEQ_AXIS if attn == "ring" else None)
+
+    block = args.seq_len // sp
+    from jax.sharding import PartitionSpec as P
+
+    def init_fn(toks):
+        t = toks[0, 0] if attn == "ring" else toks[0]
+        variables = model.init(jax.random.PRNGKey(args.seed), t)
+        return jax.tree.map(lambda a: a[None], variables["params"])
+
+    batch_spec = (P(GOSSIP_AXIS, SEQ_AXIS) if attn == "ring"
+                  else P(GOSSIP_AXIS))
+    init_sharded = jax.jit(jax.shard_map(
+        init_fn, mesh=mesh, in_specs=(batch_spec,),
+        out_specs=P(GOSSIP_AXIS)))
+    dummy_shape = ((dp, sp, args.batch_size, block) if attn == "ring"
+                   else (dp, args.batch_size, args.seq_len))
+    params = init_sharded(np.zeros(dummy_shape, np.int32))
+
+    one = lambda t: jax.tree.map(lambda a: a[0], t)
+    state = TrainState(
+        step=jnp.zeros((dp,), jnp.int32), params=params, batch_stats={},
+        opt_state=replicate_state(tx.init(one(params)), dp),
+        gossip=replicate_state(alg.init(one(params)), dp))
+
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree.leaves(one(params)))
+    log.info(f"mesh {mesh}; {n_params/1e6:.2f}M params; attn={attn}")
+
+    corpus = synthetic_lm_corpus(args.corpus_tokens,
+                                 vocab_size=args.vocab_size, seed=args.seed)
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    out_fname = os.path.join(args.checkpoint_dir,
+                             f"{args.tag}out_n{world}.csv")
+    with open(out_fname, "w") as f:
+        print("step,loss,ppl,lr,tokens_per_sec", file=f)
+
+    loss_meter = Meter(ptag="Loss")
+    steps_done = 0
+    epoch = 0
+    t0 = time.time()
+    tokens_per_step = dp * args.batch_size * args.seq_len
+    # XLA CPU in-process collectives require serialized dispatch; on TPU we
+    # fetch metrics only at print points so dispatch stays asynchronous
+    serialize = jax.default_backend() == "cpu"
+    metrics = None
+    while steps_done < args.num_steps:
+        for tokens, targets in lm_batches(corpus, dp, sp, args.batch_size,
+                                          args.seq_len,
+                                          seed=args.seed + epoch):
+            if attn != "ring":
+                tokens = tokens.reshape(dp, args.batch_size, args.seq_len)
+                targets = targets.reshape(dp, args.batch_size, args.seq_len)
+            state, metrics = train_fn(state, tokens, targets)
+            if serialize:
+                jax.block_until_ready(state)
+            steps_done += 1
+            if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
+                loss = float(np.mean(np.asarray(metrics["loss"])))
+                loss_meter.update(loss)
+                tps = tokens_per_step * steps_done / (time.time() - t0)
+                with open(out_fname, "a") as f:
+                    print(f"{steps_done},{loss:.4f},"
+                          f"{float(np.mean(np.asarray(metrics['ppl']))):.2f},"
+                          f"{float(np.mean(np.asarray(metrics['lr']))):.5f},"
+                          f"{tps:.0f}", file=f)
+            if steps_done >= args.num_steps:
+                break
+        epoch += 1
+
+    result = {"final_loss": loss_meter.val, "avg_loss": loss_meter.avg,
+              "tokens_per_sec": tokens_per_step * steps_done
+              / (time.time() - t0)}
+    log.info(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

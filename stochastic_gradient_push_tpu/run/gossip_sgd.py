@@ -96,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic_samples", default=None, type=int)
     p.add_argument("--requeue_command", default=None, type=str,
                    help="command run by rank 0 on preemption requeue")
+    p.add_argument("--precision", default="fp32",
+                   choices=["fp32", "bf16"],
+                   help="compute dtype (params and BN stats stay fp32)")
     return p
 
 
@@ -191,10 +194,13 @@ def main(argv=None, config_transform=None, extra_args=None):
         mesh = make_gossip_mesh(world)
     log.info(f"mesh: {mesh}; devices: {world}")
 
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if args.model in RESNETS:
-        model = RESNETS[args.model](num_classes=cfg.num_classes)
+        model = RESNETS[args.model](num_classes=cfg.num_classes, dtype=dtype)
     elif args.model == "tiny_cnn":
-        model = TinyCNN(num_classes=cfg.num_classes)
+        model = TinyCNN(num_classes=cfg.num_classes, dtype=dtype)
     else:
         raise SystemExit(f"unknown model {args.model}")
 

@@ -6,6 +6,7 @@ from .state import TrainState, init_train_state, sgd
 from .step import (
     build_eval_step,
     build_train_step,
+    replica_spread,
     replicate_state,
     shard_eval_step,
     shard_train_step,
@@ -27,4 +28,5 @@ __all__ = [
     "shard_eval_step",
     "replicate_state",
     "unreplicate",
+    "replica_spread",
 ]

@@ -27,6 +27,7 @@ from .state import init_train_state, sgd
 from .step import (
     build_eval_step,
     build_train_step,
+    replica_spread,
     replicate_state,
     shard_eval_step,
     shard_train_step,
@@ -247,6 +248,11 @@ class Trainer:
             start_itr = 0
 
             if not cfg.train_fast:
+                spread = replica_spread(state, alg)
+                self.log.info(
+                    f"epoch {epoch}: replica spread "
+                    f"max {spread['max_spread']:.2e} "
+                    f"mean {spread['mean_spread']:.2e}")
                 prec1 = (self.validate(state, alg, val_loader)
                          if val_loader is not None else -1.0)
                 final_prec1 = prec1

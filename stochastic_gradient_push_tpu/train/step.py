@@ -35,7 +35,8 @@ from .metrics import accuracy_topk, kl_div_loss, one_hot
 from .state import TrainState
 
 __all__ = ["build_train_step", "build_eval_step", "shard_train_step",
-           "shard_eval_step", "replicate_state", "unreplicate"]
+           "shard_eval_step", "replicate_state", "unreplicate",
+           "replica_spread"]
 
 
 def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
@@ -198,3 +199,21 @@ def replicate_state(state: TrainState, world_size: int) -> TrainState:
 def unreplicate(tree, rank: int = 0):
     """Extract one rank's slice of a world-stacked pytree."""
     return jax.tree.map(lambda a: np.asarray(a)[rank], tree)
+
+
+def replica_spread(state: TrainState, algorithm: GossipAlgorithm) -> dict:
+    """Cross-replica disagreement of the de-biased parameters.
+
+    Observability for decentralized training the reference lacks: how far
+    apart the rank replicas actually are.  Returns max/mean absolute
+    deviation from the rank-mean over all parameters (host-side numpy on a
+    world-stacked state).
+    """
+    z = jax.vmap(algorithm.eval_params)(state.params, state.gossip)
+    leaves = [np.asarray(l) for l in jax.tree.leaves(z)]
+    world = leaves[0].shape[0]
+    flat = np.concatenate([l.reshape(world, -1) for l in leaves], axis=1)
+    dev = np.abs(flat - flat.mean(axis=0, keepdims=True))
+    return {"max_spread": float(dev.max()),
+            "mean_spread": float(dev.mean()),
+            "param_scale": float(np.abs(flat).max())}

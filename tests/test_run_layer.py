@@ -120,10 +120,36 @@ def test_cli_all_reduce_baseline(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_hierarchical_and_bf16(tmp_path):
+    r = _run_cli("stochastic_gradient_push_tpu.run.gossip_sgd", tmp_path,
+                 extra=("--nprocs_per_node", "2", "--precision", "bf16"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert (tmp_path / "out_r0_n8.csv").exists()
+
+
+@pytest.mark.slow
 def test_cli_adpsgd(tmp_path):
     r = _run_cli("stochastic_gradient_push_tpu.run.gossip_sgd_adpsgd",
                  tmp_path)
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_cli_lm_ring_sp(tmp_path):
+    cmd = [sys.executable, "-m",
+           "stochastic_gradient_push_tpu.run.gossip_lm",
+           "--world_size", "8", "--sp", "2", "--seq_len", "32",
+           "--d_model", "32", "--n_layers", "1", "--n_heads", "4",
+           "--d_ff", "32", "--vocab_size", "32", "--batch_size", "2",
+           "--num_steps", "4", "--corpus_tokens", "20000",
+           "--checkpoint_dir", str(tmp_path)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                      env=CLI_ENV)
+    assert r.returncode == 0, r.stderr[-2000:]
+    csv = tmp_path / "lm_out_n8.csv"
+    assert csv.exists()
+    assert csv.read_text().splitlines()[0] == \
+        "step,loss,ppl,lr,tokens_per_sec"
 
 
 def test_cli_rejects_inconsistent_flags(tmp_path):

@@ -1,0 +1,85 @@
+"""Utility-layer tests: flatten/communicate, watchdog, discovery parsing."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stochastic_gradient_push_tpu.parallel.discovery import (
+    ClusterInfo,
+    _first_slurm_host,
+    discover,
+)
+from stochastic_gradient_push_tpu.utils import (
+    StepWatchdog,
+    communicate,
+    flatten_tensors,
+    global_norm,
+    group_by_dtype,
+    unflatten_tensors,
+)
+
+
+def _tree():
+    return {"a": jnp.arange(6.0).reshape(2, 3),
+            "b": [jnp.ones((4,), jnp.float32),
+                  jnp.asarray([1, 2, 3], jnp.int32)]}
+
+
+def test_flatten_roundtrip():
+    tree = _tree()
+    flat, unravel = flatten_tensors(tree)
+    assert flat.ndim == 1
+    restored = unflatten_tensors(flat, unravel)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_group_by_dtype():
+    groups = group_by_dtype(_tree())
+    assert set(groups) == {np.dtype(np.float32), np.dtype(np.int32)}
+    assert len(groups[np.dtype(np.float32)]) == 2
+    assert len(groups[np.dtype(np.int32)]) == 1
+
+
+def test_communicate_applies_op_per_dtype():
+    tree = {"x": jnp.ones((3,)), "y": jnp.full((2, 2), 2.0)}
+    out = communicate(tree, lambda flat: flat * 10)
+    np.testing.assert_allclose(np.asarray(out["x"]), 10 * np.ones(3))
+    np.testing.assert_allclose(np.asarray(out["y"]), 20 * np.ones((2, 2)))
+    # structure preserved
+    assert jax.tree.structure(out) == jax.tree.structure(tree)
+
+
+def test_global_norm():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    np.testing.assert_allclose(float(global_norm(tree)), 5.0)
+
+
+def test_watchdog_fires_on_slow_step_and_not_on_fast():
+    wd = StepWatchdog(timeout=0.2)
+    with wd.step():
+        pass
+    time.sleep(0.3)
+    assert not wd.timed_out
+
+    wd2 = StepWatchdog(timeout=0.1)
+    with wd2.step():
+        time.sleep(0.35)
+    assert wd2.timed_out
+
+
+def test_discover_reports_cpu_mesh():
+    info = discover()
+    assert isinstance(info, ClusterInfo)
+    assert info.platform == "cpu"
+    assert info.global_device_count >= 8
+    assert not info.is_multihost
+
+
+def test_slurm_nodelist_first_host():
+    assert _first_slurm_host("tpu-pod-[003-007,010]") == "tpu-pod-003"
+    assert _first_slurm_host("a-1,b-2") == "a-1"
+    assert _first_slurm_host("node[001-004]") == "node001"
+    assert _first_slurm_host("single") == "single"
