@@ -21,6 +21,7 @@ from .topology import (  # noqa: F401
     GraphTopology,
     MixingStrategy,
     NPeerDynamicDirectedExponentialGraph,
+    SelfWeightedMixing,
     RingGraph,
     UniformMixing,
     build_pairing_schedule,

@@ -93,7 +93,8 @@ class PushSumGossip(GossipAlgorithm):
         # subtract from the mixed total.
         num_phases = self.schedule.num_phases
         lo_table = jnp.asarray(self.schedule.self_weight, jnp.float32)
-        lo = lo_table[as_scalar(phase) % num_phases]
+        my_rank = jax.lax.axis_index(self.axis_name)
+        lo = lo_table[as_scalar(phase) % num_phases, my_rank]
         local = jax.tree.map(lambda a: a * lo.astype(a.dtype), tree)
         incoming = jax.tree.map(jnp.subtract, mixed, local)
         return local, incoming

@@ -57,20 +57,30 @@ def as_scalar(x):
     return jnp.reshape(x, ())
 
 
+def _rank_weight(table: np.ndarray, axis_name: str):
+    """This rank's weight from a per-rank table; constant-folded when all
+    ranks share one value.  Keeps the table's own (float64) precision —
+    downcast happens per-leaf at application time."""
+    if np.all(table == table[0]):
+        return jnp.asarray(table[0])
+    return jnp.asarray(table)[lax.axis_index(axis_name)]
+
+
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str):
     """Build the mixing function for one static phase of the schedule."""
-    lo = float(schedule.self_weight[phase_idx])
+    lo_table = schedule.self_weight[phase_idx]
     edge_w = schedule.edge_weights[phase_idx]
     perms = schedule.perms[phase_idx]
 
     def fn(tree):
-        out = jax.tree.map(lambda a: a * jnp.asarray(lo, a.dtype), tree)
+        lo = _rank_weight(lo_table, axis_name)
+        out = jax.tree.map(lambda a: a * lo.astype(a.dtype), tree)
         for i in range(schedule.peers_per_itr):
-            w_i = float(edge_w[i])
+            w_i = _rank_weight(edge_w[i], axis_name)
             pairs = _perm_pairs(perms[i])
             recv = jax.tree.map(
                 lambda a: lax.ppermute(
-                    a * jnp.asarray(w_i, a.dtype), axis_name, pairs),
+                    a * w_i.astype(a.dtype), axis_name, pairs),
                 tree)
             out = jax.tree.map(jnp.add, out, recv)
         return out

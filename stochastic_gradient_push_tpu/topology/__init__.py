@@ -9,7 +9,7 @@ from .graphs import (
     DynamicBipartiteLinearGraph,
     RingGraph,
 )
-from .mixing import MixingStrategy, UniformMixing
+from .mixing import MixingStrategy, SelfWeightedMixing, UniformMixing
 from .schedule import GossipSchedule, build_schedule, build_pairing_schedule
 
 # Integer registry kept flag-compatible with the reference CLI
@@ -39,6 +39,7 @@ __all__ = [
     "RingGraph",
     "MixingStrategy",
     "UniformMixing",
+    "SelfWeightedMixing",
     "GossipSchedule",
     "build_schedule",
     "build_pairing_schedule",

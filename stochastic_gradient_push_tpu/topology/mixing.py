@@ -20,7 +20,7 @@ import numpy as np
 
 from .graphs import GraphTopology
 
-__all__ = ["MixingStrategy", "UniformMixing"]
+__all__ = ["MixingStrategy", "UniformMixing", "SelfWeightedMixing"]
 
 
 class MixingStrategy:
@@ -35,11 +35,14 @@ class MixingStrategy:
         return graph.is_regular_graph() and self.is_uniform()
 
     def weights(self, graph: GraphTopology, phase: int
-                ) -> tuple[float, np.ndarray]:
-        """Returns ``(self_weight, edge_weights[peers_per_itr])`` for a phase.
+                ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns per-rank weight tables for a phase:
+        ``(self_weight[world], edge_weights[peers_per_itr, world])`` —
+        entry ``[..., r]`` is the weight rank ``r`` applies.
 
-        Column-stochasticity — ``self_weight + edge_weights.sum() == 1`` —
-        is what push-sum requires for mass conservation.
+        Column-stochasticity — ``self_weight[r] + edge_weights[:, r].sum()
+        == 1`` for every rank — is what push-sum requires for mass
+        conservation.
         """
         raise NotImplementedError
 
@@ -51,7 +54,52 @@ class UniformMixing(MixingStrategy):
         return True
 
     def weights(self, graph: GraphTopology, phase: int
-                ) -> tuple[float, np.ndarray]:
-        deg = graph.peers_per_itr if graph.world_size > 1 else 0
+                ) -> tuple[np.ndarray, np.ndarray]:
+        n = graph.world_size
+        deg = graph.peers_per_itr if n > 1 else 0
         w = 1.0 / (deg + 1.0)
-        return w, np.full((deg,), w, dtype=np.float64)
+        return (np.full((n,), w, dtype=np.float64),
+                np.full((deg, n), w, dtype=np.float64))
+
+
+class SelfWeightedMixing(MixingStrategy):
+    """Column-stochastic mixing with per-rank self weights.
+
+    Rank ``r`` keeps ``alpha[r]`` of its mass and sends
+    ``(1 - alpha[r])/deg`` along each out-edge.  With rank-dependent alphas
+    the mixing matrix is column- but not row-stochastic, so the stationary
+    distribution is non-uniform and the push-sum weight genuinely deviates
+    from 1 — the *irregular* regime the reference gates with
+    ``MixingManager.is_regular`` (mixing_manager.py:25-30) and handles by
+    appending the ps-weight to the payload (gossiper.py:83-85).  Here it
+    exercises the always-on ps-weight lane: de-biased estimates still
+    converge to the true average, the guarantee push-sum exists to provide.
+
+    A larger alpha means lazier communication for that rank (more self-mass
+    per round) — e.g. ranks on slow links can gossip less aggressively.
+
+    Args:
+      alpha: scalar in (0, 1) applied to every rank, or a per-rank
+        sequence of such values.
+    """
+
+    def __init__(self, alpha=0.5):
+        self.alpha = np.atleast_1d(np.asarray(alpha, dtype=np.float64))
+        if np.any(self.alpha <= 0.0) or np.any(self.alpha >= 1.0):
+            raise ValueError("alpha values must be in (0, 1)")
+
+    def is_uniform(self) -> bool:
+        return False
+
+    def weights(self, graph: GraphTopology, phase: int
+                ) -> tuple[np.ndarray, np.ndarray]:
+        n = graph.world_size
+        deg = graph.peers_per_itr if n > 1 else 0
+        if self.alpha.size == 1:
+            alpha = np.full((n,), float(self.alpha[0]))
+        elif self.alpha.size == n:
+            alpha = self.alpha.copy()
+        else:
+            raise ValueError(
+                f"alpha has {self.alpha.size} entries for world_size {n}")
+        return alpha, np.broadcast_to((1.0 - alpha) / deg, (deg, n)).copy()

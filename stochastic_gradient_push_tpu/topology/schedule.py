@@ -35,9 +35,10 @@ class GossipSchedule:
       perms: int32 ``(num_phases, peers_per_itr, world_size)``;
         ``perms[p, i, src]`` = destination of ``src``'s i-th message in
         phase ``p``.  Every row is a permutation.
-      self_weight: float64 ``(num_phases,)`` — weight kept locally.
-      edge_weights: float64 ``(num_phases, peers_per_itr)`` — weight applied
-        to each outgoing message.
+      self_weight: float64 ``(num_phases, world_size)`` — per-rank weight
+        kept locally.
+      edge_weights: float64 ``(num_phases, peers_per_itr, world_size)`` —
+        per-rank weight applied to each outgoing message.
       regular: whether mixing is regular (push-sum weight stays 1 across a
         complete synchronous round).
       world_size / peers_per_itr / num_phases: static ints.
@@ -61,9 +62,10 @@ class GossipSchedule:
         w = np.zeros((n, n), dtype=np.float64)
         p = phase % self.num_phases
         for src in range(n):
-            w[src, src] += self.self_weight[p]
+            w[src, src] += self.self_weight[p, src]
             for i in range(self.peers_per_itr):
-                w[self.perms[p, i, src], src] += self.edge_weights[p, i]
+                w[self.perms[p, i, src], src] += \
+                    self.edge_weights[p, i, src]
         return w
 
 
@@ -76,22 +78,24 @@ def build_schedule(graph: GraphTopology,
         ppi = graph.peers_per_itr
         return GossipSchedule(
             perms=np.zeros((1, ppi, 1), dtype=np.int32),
-            self_weight=np.ones((1,), dtype=np.float64),
-            edge_weights=np.zeros((1, ppi), dtype=np.float64),
+            self_weight=np.ones((1, 1), dtype=np.float64),
+            edge_weights=np.zeros((1, ppi, 1), dtype=np.float64),
             regular=True, world_size=1, peers_per_itr=ppi, num_phases=1)
     num_phases = graph.num_phases
+    n = graph.world_size
     perms = graph.all_phase_permutations
-    self_w = np.empty((num_phases,), dtype=np.float64)
-    edge_w = np.empty((num_phases, graph.peers_per_itr), dtype=np.float64)
+    self_w = np.empty((num_phases, n), dtype=np.float64)
+    edge_w = np.empty((num_phases, graph.peers_per_itr, n),
+                      dtype=np.float64)
     for p in range(num_phases):
         lo, ew = mixing.weights(graph, p)
         self_w[p] = lo
         edge_w[p] = ew
-        total = lo + ew.sum()
-        if abs(total - 1.0) > 1e-12:
+        totals = lo + ew.sum(axis=0)
+        if np.abs(totals - 1.0).max() > 1e-12:
             raise ValueError(
-                f"mixing weights at phase {p} sum to {total}, not 1 "
-                "(column-stochasticity violated)")
+                f"mixing weights at phase {p} have column sums {totals}, "
+                "not 1 (column-stochasticity violated)")
     return GossipSchedule(
         perms=perms,
         self_weight=self_w,

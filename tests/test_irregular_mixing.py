@@ -1,0 +1,130 @@
+"""Irregular (non-uniform) mixing: the regime where the push-sum weight
+genuinely matters.
+
+With SelfWeightedMixing the mixing matrix is column- but not
+row-stochastic: plain averaging of the raw values would converge to a
+*weighted* (wrong) average, while push-sum's de-biased estimate provably
+recovers the true mean.  These tests pin down exactly that distinction —
+the core mathematical claim of the SGP paper that none of the regular
+built-in graphs can exhibit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.algorithms import dpsgd, sgp
+from stochastic_gradient_push_tpu.parallel import (
+    GOSSIP_AXIS,
+    make_gossip_mesh,
+    mix_push_sum,
+)
+from stochastic_gradient_push_tpu.topology import (
+    NPeerDynamicDirectedExponentialGraph,
+    SelfWeightedMixing,
+    build_schedule,
+)
+
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_gossip_mesh(WORLD)
+
+
+ALPHAS = 0.3 + 0.5 * np.arange(WORLD) / (WORLD - 1)  # rank-dependent
+
+
+def test_selfweighted_schedule_is_irregular_but_column_stochastic():
+    g = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(g, SelfWeightedMixing(alpha=ALPHAS))
+    assert not sched.regular
+    for p in range(sched.num_phases):
+        W = sched.mixing_matrix(p)
+        np.testing.assert_allclose(W.sum(axis=0), np.ones(WORLD),
+                                   atol=1e-12)
+        # row sums deviate → non-uniform stationary distribution
+        assert np.abs(W.sum(axis=1) - 1.0).max() > 0.05
+
+
+def test_push_sum_recovers_true_mean_under_irregular_mixing(mesh):
+    g = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(g, SelfWeightedMixing(alpha=ALPHAS))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(WORLD, 5)).astype(np.float32)
+    w = np.ones((WORLD, 1), np.float32)
+    true_mean = x.mean(axis=0)
+
+    def step(phase, xs, ws):
+        return mix_push_sum(xs, ws, phase, sched, GOSSIP_AXIS)
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+        out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+
+    for phase in range(120):
+        x, w = map(np.asarray, f(jnp.int32(phase), x, w))
+
+    # the ps-weights genuinely deviate from 1 (irregular regime)
+    assert np.abs(w - 1.0).max() > 1e-3, w.ravel()
+    # raw values converge to the (biased) weighted average, NOT the mean:
+    # exactly the error push-sum's division corrects
+    assert np.abs(x - true_mean).max() > 1e-3
+    # de-biased estimates recover the true mean on every rank
+    np.testing.assert_allclose(x / w, np.broadcast_to(true_mean, x.shape),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sgp_trains_under_irregular_mixing(mesh):
+    """SGP with irregular mixing still solves the consensus optimization."""
+    g = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(g, SelfWeightedMixing(alpha=ALPHAS))
+    alg = sgp(sched, GOSSIP_AXIS)
+    rng = np.random.default_rng(1)
+    targets = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    lr = 0.05
+
+    def step(params, gstate, target):
+        params, gstate = alg.pre_step(params, gstate)
+        z = alg.eval_params(params, gstate)
+        grads = jax.grad(lambda p: 0.5 * jnp.sum((p - target) ** 2))(z)
+        params = params - lr * grads
+        return alg.post_step(params, gstate)
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(GOSSIP_AXIS),) * 3,
+        out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+
+    params = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    gstate = jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a),
+                                  (WORLD,) + np.shape(a)).copy(),
+        alg.init(jnp.zeros((4,), jnp.float32)))
+    for _ in range(500):
+        params, gstate = jax.block_until_ready(f(params, gstate, targets))
+
+    w = np.asarray(gstate.ps_weight).reshape(WORLD, 1)
+    z = np.asarray(params) / w
+    np.testing.assert_allclose(z.mean(axis=0), targets.mean(axis=0),
+                               atol=5e-3)
+
+
+def test_dpsgd_rejects_irregular_mixing():
+    g = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(g, SelfWeightedMixing(alpha=ALPHAS))
+    with pytest.raises(ValueError, match="regular"):
+        dpsgd(sched, GOSSIP_AXIS)
+
+
+def test_selfweighted_alpha_validation():
+    with pytest.raises(ValueError):
+        SelfWeightedMixing(alpha=0.0)
+    with pytest.raises(ValueError):
+        SelfWeightedMixing(alpha=1.0)
+    with pytest.raises(ValueError, match="entries"):
+        build_schedule(NPeerDynamicDirectedExponentialGraph(WORLD),
+                       SelfWeightedMixing(alpha=[0.5, 0.5, 0.5]))
