@@ -59,8 +59,9 @@ def as_scalar(x):
 
 def _rank_weight(table: np.ndarray, axis_name: str):
     """This rank's weight from a per-rank table; constant-folded when all
-    ranks share one value.  Keeps the table's own (float64) precision —
-    downcast happens per-leaf at application time."""
+    ranks share one value.  jnp.asarray keeps float64 only under
+    jax_enable_x64; with the default config weights are float32 before the
+    per-leaf cast."""
     if np.all(table == table[0]):
         return jnp.asarray(table[0])
     return jnp.asarray(table)[lax.axis_index(axis_name)]

@@ -9,6 +9,7 @@ from .step import (
     replica_spread,
     replicate_state,
     shard_eval_step,
+    shard_scanned_train_step,
     shard_train_step,
     unreplicate,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "build_train_step",
     "build_eval_step",
     "shard_train_step",
+    "shard_scanned_train_step",
     "shard_eval_step",
     "replicate_state",
     "unreplicate",

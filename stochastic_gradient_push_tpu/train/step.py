@@ -35,8 +35,8 @@ from .metrics import accuracy_topk, kl_div_loss, one_hot
 from .state import TrainState
 
 __all__ = ["build_train_step", "build_eval_step", "shard_train_step",
-           "shard_eval_step", "replicate_state", "unreplicate",
-           "replica_spread"]
+           "shard_scanned_train_step", "shard_eval_step",
+           "replicate_state", "unreplicate", "replica_spread"]
 
 
 def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
@@ -153,6 +153,47 @@ def shard_train_step(step_fn, mesh, axis_name: str = GOSSIP_AXIS,
         new_state, metrics = step_fn(
             squeeze(state), squeeze(images), squeeze(labels))
         return unsqueeze(new_state), unsqueeze(metrics)
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(axis_name), batch_spec, batch_spec),
+        out_specs=(P(axis_name), P(axis_name)))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_scanned_train_step(step_fn, mesh, n_steps: int,
+                             axis_name: str = GOSSIP_AXIS,
+                             local_axis: str | None = None):
+    """Fuse ``n_steps`` train steps into ONE compiled program via
+    ``lax.scan``.
+
+    The reference pays a host round-trip per iteration (Python loop →
+    dispatch → gossip thread handshake).  Here the whole micro-epoch is a
+    single XLA program: dispatch overhead is amortized ``n_steps``×, and
+    the latency-hiding scheduler can pipeline each step's gossip ppermute
+    against the next step's compute without the host in the way.
+
+    Batches gain a leading scan dimension: ``images[n_steps, world, ...]``.
+    Returns ``(state, metrics)`` with metrics stacked ``[world, n_steps]``.
+    """
+    batch_spec = (P(None, axis_name) if local_axis is None
+                  else P(None, (axis_name, local_axis)))
+
+    def wrapped(state, images, labels):
+        squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
+        # per-shard batches are [n_steps, 1, ...] → drop the shard axis
+        images = jax.tree.map(lambda a: a[:, 0], images)
+        labels = jax.tree.map(lambda a: a[:, 0], labels)
+
+        def body(st, batch):
+            im, lb = batch
+            st, metrics = step_fn(st, im, lb)
+            return st, metrics
+
+        new_state, metrics = lax.scan(body, squeeze(state),
+                                      (images, labels))
+        return (jax.tree.map(lambda a: a[None], new_state),
+                jax.tree.map(lambda a: a[None], metrics))
 
     sharded = jax.shard_map(
         wrapped, mesh=mesh,

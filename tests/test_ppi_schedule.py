@@ -1,0 +1,61 @@
+"""Peers-per-iteration schedule changes mid-training (SURVEY.md §7 hard
+part #2): each ppi value is its own compiled step variant; switching must
+preserve training state and keep the gossip math sound."""
+
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.data import (
+    DistributedSampler,
+    ShardedLoader,
+    synthetic_classification,
+)
+from stochastic_gradient_push_tpu.models import TinyMLP
+from stochastic_gradient_push_tpu.parallel import make_gossip_mesh
+from stochastic_gradient_push_tpu.topology import (
+    NPeerDynamicDirectedExponentialGraph,
+)
+from stochastic_gradient_push_tpu.train.loop import Trainer, TrainerConfig
+
+WORLD = 8
+BATCH = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_gossip_mesh(WORLD)
+
+
+def test_training_across_ppi_switch(mesh, tmp_path):
+    """Epoch 0 gossips with 1 peer, epoch 1+ with 2: the trainer must
+    rebuild the compiled step at the boundary and keep converging."""
+    images, labels = synthetic_classification(
+        n=WORLD * BATCH * 4, num_classes=4, image_size=8, seed=0)
+    cfg = TrainerConfig(
+        graph_class=NPeerDynamicDirectedExponentialGraph,
+        ppi_schedule={0: 1, 1: 2},
+        lr=0.5, warmup=False, lr_schedule={},
+        batch_size=BATCH, num_epochs=3, num_itr_ignore=0,
+        checkpoint_dir=str(tmp_path), num_classes=4, verbose=False)
+    trainer = Trainer(cfg, TinyMLP(num_classes=4), mesh,
+                      sample_input_shape=(BATCH, 8, 8, 3))
+    state = trainer.init_state()
+
+    sampler = DistributedSampler(len(images), WORLD)
+    loader = ShardedLoader(images, labels, BATCH, sampler)
+    state, result = trainer.fit(state, loader, sampler, val_loader=loader)
+
+    # two distinct compiled variants were built (ppi 1 and ppi 2)
+    ppis = {key[0] for key in trainer._step_cache}
+    assert ppis == {1, 2}
+    assert result["best_prec1"] > 50.0
+    # gossip state stays sound across the switch
+    w = np.asarray(state.gossip.ps_weight)
+    np.testing.assert_allclose(w, np.ones_like(w), atol=1e-3)
+
+
+def test_ppi_2_schedule_has_more_edges(mesh):
+    g1 = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    g2 = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=2)
+    assert g2.all_phase_permutations.shape[1] == 2
+    assert g1.all_phase_permutations.shape[1] == 1

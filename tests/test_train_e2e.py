@@ -67,7 +67,7 @@ def build_everything(algorithm_factory, mesh, itr_per_epoch=6):
     state0 = init_train_state(
         model, jax.random.PRNGKey(47),
         jnp.zeros((BATCH, IMG, IMG, 3)), tx, alg)
-    return model, alg, sharded, replicate_state(state0, WORLD)
+    return model, alg, sharded, replicate_state(state0, WORLD), step
 
 
 def run_epochs(sharded, state, images, labels, epochs=2, seed=47):
@@ -91,7 +91,7 @@ def run_epochs(sharded, state, images, labels, epochs=2, seed=47):
 ])
 def test_training_reduces_loss_and_reaches_consensus(mesh, data, factory):
     images, labels = data
-    model, alg, sharded, state = build_everything(factory, mesh)
+    model, alg, sharded, state, _ = build_everything(factory, mesh)
     state, losses = run_epochs(sharded, state, images, labels, epochs=4)
 
     first = np.mean(losses[:4])
@@ -110,7 +110,7 @@ def test_training_reduces_loss_and_reaches_consensus(mesh, data, factory):
 
 def test_eval_step_runs_and_scores_above_chance(mesh, data):
     images, labels = data
-    model, alg, sharded, state = build_everything(
+    model, alg, sharded, state, _ = build_everything(
         lambda s: sgp(s, GOSSIP_AXIS), mesh)
     state, _ = run_epochs(sharded, state, images, labels, epochs=4)
 
@@ -156,7 +156,7 @@ def test_sampler_epoch_determinism_and_coverage(data):
 def test_early_exit_iteration_cap(mesh, data):
     """≙ --num_iterations_per_training_epoch (gossip_sgd.py:83-88)."""
     images, labels = data
-    _, _, sharded, state = build_everything(
+    _, _, sharded, state, _ = build_everything(
         lambda s: sgp(s, GOSSIP_AXIS), mesh)
     sampler = DistributedSampler(len(images), WORLD)
     loader = ShardedLoader(images, labels, BATCH, sampler)
@@ -169,3 +169,40 @@ def test_early_exit_iteration_cap(mesh, data):
             break
     assert steps == cap
     assert int(np.asarray(state.step)[0]) == cap
+
+
+def test_scanned_steps_equal_sequential_steps(mesh, data):
+    """k scanned steps == k sequential dispatches, bit-for-bit-ish."""
+    from stochastic_gradient_push_tpu.train import shard_scanned_train_step
+
+    images, labels = data
+    k = 4
+    model, alg, sharded, state_a, step = build_everything(
+        lambda s: sgp(s, GOSSIP_AXIS), mesh)
+    state_b = jax.tree.map(jnp.copy, state_a)
+
+    sampler = DistributedSampler(len(images), WORLD)
+    loader = ShardedLoader(images, labels, BATCH, sampler)
+    xs, ys = [], []
+    it = iter(loader)
+    for _ in range(k):
+        x, y = next(it)
+        xs.append(x)
+        ys.append(y)
+
+    # sequential
+    for x, y in zip(xs, ys):
+        state_a, _ = sharded(state_a, x, y)
+        jax.block_until_ready(state_a)
+
+    # scanned: the SAME per-rank step fused over k iterations
+    scanned = shard_scanned_train_step(step, mesh, n_steps=k)
+    state_b, metrics = scanned(state_b, np.stack(xs), np.stack(ys))
+    jax.block_until_ready(state_b)
+
+    assert np.asarray(metrics["loss"]).shape == (WORLD, k)
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert int(np.asarray(state_b.step)[0]) == k
