@@ -99,6 +99,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--precision", default="fp32",
                    choices=["fp32", "bf16"],
                    help="compute dtype (params and BN stats stay fp32)")
+    p.add_argument("--scan_steps", default=1, type=int,
+                   help="fuse this many iterations into one compiled "
+                        "program (dispatch amortization on TPU)")
     return p
 
 
@@ -158,6 +161,7 @@ def parse_config(argv=None):
         checkpoint_all=_str_bool(args.checkpoint_all),
         overwrite_checkpoints=_str_bool(args.overwrite_checkpoints),
         num_classes=args.num_classes,
+        scan_steps=args.scan_steps,
     )
     return cfg, args
 

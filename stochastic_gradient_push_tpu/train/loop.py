@@ -30,6 +30,7 @@ from .step import (
     replica_spread,
     replicate_state,
     shard_eval_step,
+    shard_scanned_train_step,
     shard_train_step,
 )
 
@@ -81,6 +82,9 @@ class TrainerConfig:
     # hierarchical gossip: exact psum averaging inside a node, gossip
     # between nodes (≙ nprocs_per_node, distributed.py:62-78)
     nprocs_per_node: int = 1
+    # fuse this many iterations into one compiled program (lax.scan);
+    # per-iteration metrics are still logged from the stacked outputs
+    scan_steps: int = 1
 
 
 class Trainer:
@@ -116,6 +120,10 @@ class Trainer:
                       nesterov=config.nesterov)
         self.lr_schedule_obj = None  # built per-fit (needs itr_per_epoch)
         self._step_cache: dict[tuple, tp.Callable] = {}
+        # (step key, shapes) call counts: the first call compiles, and the
+        # second can recompile again because donation turns the host-numpy
+        # state of call 1 into device-sharded arrays from call 2 on
+        self._warm_counts: dict = {}
         self._current_ppi: int | None = None
         self._eval_fn = None
 
@@ -139,19 +147,26 @@ class Trainer:
             return sgp(schedule, axis, overlap=cfg.overlap)
         return dpsgd(schedule, axis, overlap=cfg.overlap)
 
-    def _train_fn(self, ppi: int, itr_per_epoch: int):
-        """Compiled step for a peers-per-itr value; each distinct ppi is its
-        own compiled variant (SURVEY.md §7 hard part #2 — the reference
-        mutates the gossiper in place, gossip_sgd.py:497-505)."""
-        key = (ppi, itr_per_epoch)
+    def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):
+        """Compiled step for a peers-per-itr value; each distinct
+        (ppi, scan) is its own compiled variant (SURVEY.md §7 hard part #2
+        — the reference mutates the gossiper in place,
+        gossip_sgd.py:497-505)."""
+        key = (ppi, itr_per_epoch, scan)
         if key not in self._step_cache:
             alg = self.make_algorithm(ppi)
             step = build_train_step(
                 self.model, alg, self.tx, self.lr_schedule_obj,
                 itr_per_epoch=itr_per_epoch, num_classes=self.cfg.num_classes,
                 local_axis=self.local_axis)
-            self._step_cache[key] = (alg, shard_train_step(
-                step, self.mesh, self.gossip_axis, self.local_axis))
+            if scan > 1:
+                fn = shard_scanned_train_step(
+                    step, self.mesh, scan, self.gossip_axis,
+                    self.local_axis)
+            else:
+                fn = shard_train_step(
+                    step, self.mesh, self.gossip_axis, self.local_axis)
+            self._step_cache[key] = (alg, fn)
         return self._step_cache[key]
 
     # -- csv logging -------------------------------------------------------
@@ -241,10 +256,11 @@ class Trainer:
             sampler.set_epoch(epoch + cfg.seed * 90)  # gossip_sgd.py:289
             ppi = (ppi_at_epoch(cfg.ppi_schedule, epoch)
                    if not cfg.all_reduce else 1)
-            alg, train_fn = self._train_fn(ppi, itr_per_epoch)
+            alg, _ = self._train_fn(ppi, itr_per_epoch)
 
             state = self._train_epoch(
-                state, train_fn, train_loader, epoch, start_itr, meters)
+                state, ppi, itr_per_epoch, train_loader, epoch, start_itr,
+                meters)
             start_itr = 0
 
             if not cfg.train_fast:
@@ -285,44 +301,107 @@ class Trainer:
                        "elapsed_time": time.time() - begin_time,
                        "batch_meter": batch_meter}
 
-    def _train_epoch(self, state, train_fn, loader, epoch, start_itr,
-                     meters):
+    def _train_epoch(self, state, ppi, itr_per_epoch, loader, epoch,
+                     start_itr, meters):
         cfg = self.cfg
         batch_meter, nn_meter, data_meter = meters
         losses = Meter(ptag="Loss")
         top1 = Meter(ptag="Prec@1")
         top5 = Meter(ptag="Prec@5")
         num_itr_ignore = cfg.num_itr_ignore
+        cap = cfg.num_iterations_per_training_epoch
+        cap = None if cap in (None, -1) else cap
 
         if start_itr:
             loader.fast_forward(start_itr)
 
-        batch_time = time.time()
-        i = start_itr - 1
-        for i, (x, y) in enumerate(iter(loader), start=start_itr):
-            if num_itr_ignore == 0:
-                data_meter.update(time.time() - batch_time)
+        def record(i, metric_slices, chunk, elapsed_nn, elapsed_batch,
+                   elapsed_data, timed):
+            """Update meters/CSV from ``chunk`` iterations' metrics.
+            Chunks never straddle the warm-up boundary, so either every
+            iteration here is ignored or none is; a chunk that triggered a
+            fresh XLA compile is never timed either."""
+            nonlocal num_itr_ignore
+            for j in range(chunk):
+                if num_itr_ignore == 0:
+                    if timed:
+                        nn_meter.update(elapsed_nn / chunk)
+                        batch_meter.update(elapsed_batch / chunk)
+                        data_meter.update(elapsed_data / chunk)
+                else:
+                    num_itr_ignore -= 1
+                n = metric_slices["n"]
+                losses.update(metric_slices["loss"][j], n)
+                top1.update(metric_slices["top1"][j], n)
+                top5.update(metric_slices["top5"][j], n)
+                itr = i + j
+                if itr % cfg.print_freq == 0:
+                    self._log_row(epoch, itr, meters, losses, top1, top5)
 
+        it = iter(loader)
+        i = start_itr - 1
+        batch_time = time.time()
+        while True:
+            remaining = None if cap is None else cap - (i + 1)
+            if remaining is not None and remaining <= 0:
+                break
+            # chunk sizing: single steps through the warm-up window (so
+            # compile time stays out of the timed iterations) and for any
+            # tail shorter than scan_steps (so no remainder-sized program
+            # is ever compiled) — otherwise exactly scan_steps
+            target = cfg.scan_steps
+            if num_itr_ignore > 0 or target <= 1:
+                target = 1
+            if remaining is not None and remaining < target:
+                # cap tail: single steps, never a remainder-sized program
+                target = 1
+            pending = []
+            for _ in range(target):
+                try:
+                    pending.append(next(it))
+                except StopIteration:
+                    break
+            if not pending:
+                break
+            if 1 < len(pending) < target:
+                # loader tail (only reachable after StopIteration): push the
+                # extras back and continue with single steps
+                leftovers = pending[1:]
+                pending = pending[:1]
+                it = iter(leftovers)
+            chunk = len(pending)
+
+            _, train_fn = self._train_fn(
+                ppi, itr_per_epoch, chunk if chunk > 1 else 1)
+            if chunk > 1:
+                x = np.stack([b[0] for b in pending])
+                y = np.stack([b[1] for b in pending])
+            else:
+                x, y = pending[0]
+            elapsed_data = time.time() - batch_time  # includes host stacking
             nn_time = time.time()
+            warm_key = (ppi, itr_per_epoch, chunk, np.shape(x))
+            timed = self._warm_counts.get(warm_key, 0) >= 2
+            self._warm_counts[warm_key] = \
+                self._warm_counts.get(warm_key, 0) + 1
             state, metrics = train_fn(state, x, y)
             jax.block_until_ready(state)
-            if num_itr_ignore == 0:
-                nn_meter.update(time.time() - nn_time)
-                batch_meter.update(time.time() - batch_time)
+            # metrics: [world] for a single step, [world, chunk] scanned —
+            # normalize to per-iteration arrays averaged over ranks
+            to_arr = lambda m: np.atleast_1d(
+                np.mean(np.asarray(m), axis=0)).reshape(chunk)
+            slices = {
+                "n": pending[0][0].shape[0] * pending[0][0].shape[1],
+                "loss": to_arr(metrics["loss"]),
+                "top1": to_arr(metrics["top1"]),
+                "top5": to_arr(metrics["top5"]),
+            }
+            elapsed_nn = time.time() - nn_time
+            elapsed_batch = time.time() - batch_time
+            record(i + 1, slices, chunk, elapsed_nn, elapsed_batch,
+                   elapsed_data, timed)
+            i += chunk
             batch_time = time.time()
-
-            n = x.shape[0] * x.shape[1]
-            losses.update(float(np.mean(metrics["loss"])), n)
-            top1.update(float(np.mean(metrics["top1"])), n)
-            top5.update(float(np.mean(metrics["top5"])), n)
-            if i % cfg.print_freq == 0:
-                self._log_row(epoch, i, meters, losses, top1, top5)
-            if num_itr_ignore > 0:
-                num_itr_ignore -= 1
-
-            if (cfg.num_iterations_per_training_epoch not in (None, -1)
-                    and i + 1 == cfg.num_iterations_per_training_epoch):
-                break
 
         self._log_row(epoch, i, meters, losses, top1, top5)
         return state
