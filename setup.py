@@ -25,7 +25,8 @@ setup(
     ],
     extras_require={
         "parse": ["pandas", "matplotlib"],
-        "imagefolder": ["torch", "torchvision"],
+        "imagefolder": ["Pillow"],
+        "orbax": ["orbax-checkpoint"],
     },
     entry_points={
         "console_scripts": [

@@ -1,6 +1,8 @@
 """Input pipelines."""
 
+from .imagefolder import ImageFolderDataset, load_image, scan_image_folder
 from .lm import lm_batches, synthetic_lm_corpus
+from .streaming import StreamingImageFolder
 from .pipeline import (
     DistributedSampler,
     ShardedLoader,
@@ -15,4 +17,8 @@ __all__ = [
     "imagefolder_arrays",
     "synthetic_lm_corpus",
     "lm_batches",
+    "ImageFolderDataset",
+    "StreamingImageFolder",
+    "scan_image_folder",
+    "load_image",
 ]

@@ -16,8 +16,8 @@ Mirrors the reference's data plumbing (gossip_sgd.py:539-583):
   dataset (class-dependent means + noise) used by smoke tests and
   benchmarks; the reference has no equivalent (its only testing affordance
   is early-exit, SURVEY.md §4).
-* :func:`imagefolder_arrays` — ImageNet-style directory loading via
-  torchvision when available (CPU decode), for accuracy-parity runs.
+* :func:`imagefolder_arrays` — eager ImageNet-style directory loading
+  (PIL decode, see imagefolder.py) for accuracy-parity runs.
 """
 
 from __future__ import annotations
@@ -127,41 +127,24 @@ def synthetic_classification(n: int, num_classes: int = 10,
 def imagefolder_arrays(root: str, split: str, image_size: int = 224,
                        train: bool = True,
                        limit: int | None = None) -> tuple[np.ndarray, np.ndarray]:
-    """Load an ImageNet-style folder through torchvision (CPU decode).
+    """Eagerly load an ImageNet-style folder (PIL decode, no torchvision).
 
     Transform parity with gossip_sgd.py:546-581: train = RandomResizedCrop +
-    horizontal flip; val = Resize(256) + CenterCrop; both normalized with
-    the ImageNet mean/std.  Returns NHWC float32 arrays.
+    horizontal flip; val = Resize(256·size/224) + CenterCrop; both
+    normalized with the ImageNet mean/std.  Returns NHWC float32 arrays.
 
-    This eager loader is intended for validation sets and accuracy-parity
-    runs; large-scale input pipelines should stream per-batch instead.
+    Intended for validation sets and smoke runs; use
+    :class:`~.streaming.StreamingImageFolder` for large training sets.
     """
-    import torch
-    import torchvision.datasets as datasets
-    import torchvision.transforms as transforms
+    from .imagefolder import ImageFolderDataset
 
-    normalize = transforms.Normalize(mean=[0.485, 0.456, 0.406],
-                                     std=[0.229, 0.224, 0.225])
-    if train:
-        tf = transforms.Compose([
-            transforms.RandomResizedCrop(image_size),
-            transforms.RandomHorizontalFlip(),
-            transforms.ToTensor(), normalize])
-    else:
-        tf = transforms.Compose([
-            transforms.Resize(int(image_size * 256 / 224)),
-            transforms.CenterCrop(image_size),
-            transforms.ToTensor(), normalize])
-    ds = datasets.ImageFolder(f"{root}/{split}", tf)
+    ds = ImageFolderDataset(f"{root}/{split}" if split else root,
+                            image_size=image_size, train=train)
+    idx = np.arange(len(ds))
     if limit is not None and limit < len(ds):
-        # ImageFolder is ordered by class; subsample uniformly so a limited
-        # load still covers all classes instead of the first few
-        sel = np.linspace(0, len(ds) - 1, limit).astype(np.int64)
-        ds = torch.utils.data.Subset(ds, sel.tolist())
-    loader = torch.utils.data.DataLoader(ds, batch_size=256, shuffle=False)
-    images, labels = [], []
-    for x, y in loader:
-        images.append(x.numpy().transpose(0, 2, 3, 1))  # NCHW → NHWC
-        labels.append(y.numpy())
-    return (np.concatenate(images).astype(np.float32),
-            np.concatenate(labels).astype(np.int32))
+        # directory order is class-grouped; subsample uniformly so a
+        # limited load still covers all classes instead of the first few
+        idx = np.linspace(0, len(ds) - 1, limit).astype(np.int64)
+    images = np.stack([ds[int(i)][0] for i in idx])
+    labels = ds.labels[idx]
+    return images.astype(np.float32), labels.astype(np.int32)

@@ -41,8 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-agent batch size")
     p.add_argument("--lr", default=0.1, type=float,
                    help="reference lr for a 256-sample global batch")
-    p.add_argument("--num_dataloader_workers", default=0, type=int,
-                   help="accepted for compatibility; loading is in-process")
+    p.add_argument("--num_dataloader_workers", default=8, type=int,
+                   help="decode worker threads for the imagefolder "
+                        "streaming loader (synthetic data ignores this)")
     p.add_argument("--num_epochs", default=90, type=int)
     p.add_argument("--num_iterations_per_training_epoch", default=None,
                    type=int, help="early exit for testing")
@@ -162,6 +163,7 @@ def parse_config(argv=None):
         overwrite_checkpoints=_str_bool(args.overwrite_checkpoints),
         num_classes=args.num_classes,
         scan_steps=args.scan_steps,
+        num_dataloader_workers=args.num_dataloader_workers,
     )
     return cfg, args
 
@@ -182,7 +184,8 @@ def main(argv=None, config_transform=None, extra_args=None):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     from ..data import (DistributedSampler, ShardedLoader,
-                        imagefolder_arrays, synthetic_classification)
+                        StreamingImageFolder, imagefolder_arrays,
+                        synthetic_classification)
     from ..models import RESNETS, TinyCNN
     from ..parallel import make_gossip_mesh, make_hierarchical_mesh
     from ..train.loop import Trainer
@@ -217,19 +220,27 @@ def main(argv=None, config_transform=None, extra_args=None):
             image_size=args.image_size, seed=cfg.seed)
         images, labels = all_images[:n], all_labels[:n]
         val_images, val_labels = all_images[n:], all_labels[n:]
+        sampler = DistributedSampler(len(images), world)
+        loader = ShardedLoader(images, labels, cfg.batch_size, sampler)
     else:
         if not args.dataset_dir:
             raise SystemExit("--dataset_dir required for imagefolder")
-        images, labels = imagefolder_arrays(
-            args.dataset_dir, "train", args.image_size, train=True)
-        val_images, val_labels = imagefolder_arrays(
-            args.dataset_dir, "val", args.image_size, train=False)
+        # both splits stream with background decode; val never needs the
+        # whole split resident in host memory
+        workers = args.num_dataloader_workers or 8
+        loader = StreamingImageFolder(
+            args.dataset_dir, "train", world, cfg.batch_size,
+            image_size=args.image_size, train=True,
+            num_workers=workers, seed=cfg.seed)
+        sampler = loader  # owns set_epoch for both sampling and augment
+        val_loader = StreamingImageFolder(
+            args.dataset_dir, "val", world, cfg.batch_size,
+            image_size=args.image_size, train=False, num_workers=workers)
 
-    sampler = DistributedSampler(len(images), world)
-    loader = ShardedLoader(images, labels, cfg.batch_size, sampler)
-    val_sampler = DistributedSampler(len(val_images), world)
-    val_loader = ShardedLoader(val_images, val_labels, cfg.batch_size,
-                               val_sampler)
+    if args.dataset == "synthetic":
+        val_sampler = DistributedSampler(len(val_images), world)
+        val_loader = ShardedLoader(val_images, val_labels, cfg.batch_size,
+                                   val_sampler)
 
     ckpt = CheckpointManager(cfg.checkpoint_dir, tag=cfg.tag,
                              world_size=world,
@@ -237,10 +248,11 @@ def main(argv=None, config_transform=None, extra_args=None):
     cluster = ClusterManager(ckpt, requeue_command=args.requeue_command or
                              _default_requeue())
 
+    channels = images.shape[-1] if args.dataset == "synthetic" else 3
     trainer = Trainer(cfg, model, mesh,
                       sample_input_shape=(
                           cfg.batch_size, args.image_size, args.image_size,
-                          images.shape[-1]),
+                          channels),
                       cluster_manager=cluster)
     state = trainer.init_state()
     state, result = trainer.fit(state, loader, sampler, val_loader)

@@ -85,6 +85,8 @@ class TrainerConfig:
     # fuse this many iterations into one compiled program (lax.scan);
     # per-iteration metrics are still logged from the stacked outputs
     scan_steps: int = 1
+    # decode workers for streaming loaders (reported in the CSV preamble)
+    num_dataloader_workers: int = 0
 
 
 class Trainer:
@@ -177,7 +179,7 @@ class Trainer:
             with open(self.out_fname, "w") as f:
                 print("BEGIN-TRAINING\n"
                       f"World-Size,{self.world_size}\n"
-                      "Num-DLWorkers,0\n"
+                      f"Num-DLWorkers,{self.cfg.num_dataloader_workers}\n"
                       f"Batch-Size,{self.cfg.batch_size}\n"
                       "Epoch,itr,BT(s),avg:BT(s),std:BT(s),"
                       "NT(s),avg:NT(s),std:NT(s),"
@@ -417,12 +419,19 @@ class Trainer:
         losses = Meter(ptag="Loss")
         top1 = Meter(ptag="Prec@1")
         top5 = Meter(ptag="Prec@5")
+        n_batches = 0
         for x, y in val_loader:
             m = self._eval_fn(state, x, y)
             n = x.shape[0] * x.shape[1]
             losses.update(float(np.mean(m["loss"])), n)
             top1.update(float(np.mean(m["top1"])), n)
             top5.update(float(np.mean(m["top5"])), n)
+            n_batches += 1
+        if n_batches == 0:
+            self.log.warning(
+                "validation loader yielded no batches (dataset smaller "
+                "than one world batch?) — reporting -1")
+            return -1.0
         self.log.info(
             f" * Prec@1 {top1.avg:.3f} Prec@5 {top5.avg:.3f}")
         return top1.avg
