@@ -22,6 +22,7 @@ Run (virtual 8-device CPU mesh):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 
 from ..topology import GRAPH_TOPOLOGIES, MIXING_STRATEGIES
@@ -103,6 +104,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan_steps", default=1, type=int,
                    help="fuse this many iterations into one compiled "
                         "program (dispatch amortization on TPU)")
+    p.add_argument("--profile_dir", default=None, type=str,
+                   help="capture a jax.profiler device trace into this "
+                        "directory (TensorBoard format); bounded by "
+                        "--profile_epochs")
+    p.add_argument("--profile_epochs", default=1, type=int,
+                   help="trace only the first N epochs of the run "
+                        "(a full-run trace is unloadable for real jobs)")
     return p
 
 
@@ -255,6 +263,20 @@ def main(argv=None, config_transform=None, extra_args=None):
                           channels),
                       cluster_manager=cluster)
     state = trainer.init_state()
+    if args.profile_dir:
+        # profile a bounded window: a separate short fit() under the trace,
+        # then continue the real run untraced
+        from ..utils import trace
+
+        profile_cfg = dataclasses.replace(
+            cfg, num_epochs=min(args.profile_epochs, cfg.num_epochs),
+            train_fast=True, resume=False)
+        profile_trainer = Trainer(
+            profile_cfg, model, mesh,
+            sample_input_shape=(cfg.batch_size, args.image_size,
+                                args.image_size, channels))
+        with trace(args.profile_dir):
+            state, _ = profile_trainer.fit(state, loader, sampler, None)
     state, result = trainer.fit(state, loader, sampler, val_loader)
     log.info(f"done: {result['best_prec1']:.3f} best top-1, "
              f"elapsed {result['elapsed_time']:.1f}s")

@@ -1,0 +1,61 @@
+"""Robustness matrix: every graph family x world size either builds a valid
+schedule or raises a clean ValueError — never a crash or a silently broken
+permutation (the reference assumed power-of-two worlds and could IndexError
+or deadlock otherwise)."""
+
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.topology import (
+    DynamicBipartiteExponentialGraph,
+    DynamicBipartiteLinearGraph,
+    DynamicDirectedExponentialGraph,
+    DynamicDirectedLinearGraph,
+    NPeerDynamicDirectedExponentialGraph,
+    RingGraph,
+    build_schedule,
+)
+
+ALL = [DynamicDirectedExponentialGraph,
+       NPeerDynamicDirectedExponentialGraph,
+       DynamicBipartiteExponentialGraph,
+       DynamicDirectedLinearGraph,
+       DynamicBipartiteLinearGraph,
+       RingGraph]
+
+
+# sizes every family must support (power-of-two worlds, the reference's
+# deployment shape) — rejection here is a regression, not robustness
+MUST_BUILD = {4, 8, 16}
+
+
+@pytest.mark.parametrize("cls", ALL)
+@pytest.mark.parametrize("world", list(range(2, 17)))
+def test_build_or_clean_error(cls, world):
+    try:
+        g = cls(world_size=world, peers_per_itr=1)
+        sched = build_schedule(g)
+    except ValueError:
+        assert world not in MUST_BUILD, \
+            f"{cls.__name__} must support world_size={world}"
+        return  # clean rejection is acceptable for odd sizes
+    # if it builds, it must be mathematically sound
+    for p in range(sched.num_phases):
+        W = sched.mixing_matrix(p)
+        np.testing.assert_allclose(W.sum(axis=0), np.ones(world),
+                                   atol=1e-12)
+        # every row of the permutation table is a permutation
+        for i in range(sched.peers_per_itr):
+            assert sorted(sched.perms[p, i].tolist()) == list(range(world))
+
+
+@pytest.mark.parametrize("world,ppi", [(9, 2), (12, 3), (16, 5), (10, 2)])
+def test_npdde_nonstandard_ppi_world(world, ppi):
+    try:
+        g = NPeerDynamicDirectedExponentialGraph(world, peers_per_itr=ppi)
+        sched = build_schedule(g)
+    except ValueError:
+        return
+    for p in range(sched.num_phases):
+        for i in range(sched.peers_per_itr):
+            assert sorted(sched.perms[p, i].tolist()) == list(range(world))
