@@ -59,12 +59,23 @@ class PushSumGossip(GossipAlgorithm):
     name = "sgp"
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
-                 overlap: bool = False, track_weight: bool = True):
+                 overlap: bool = False, track_weight: bool = True,
+                 gossip_every: int = 1):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
         # push-pull (D-PSGD) reuses this machinery with no ps-weight
         self.track_weight = track_weight
+        # communication thinning: gossip on every k-th step only (the
+        # compiled counterpart of the reference's synch_freq intent —
+        # fewer communications per optimization step)
+        if gossip_every < 1:
+            raise ValueError("gossip_every must be >= 1")
+        if gossip_every > 1 and overlap:
+            raise ValueError(
+                "gossip_every > 1 is a synchronous-mode knob; overlap "
+                "already hides the collective behind compute")
+        self.gossip_every = gossip_every
 
     # -- helpers -----------------------------------------------------------
 
@@ -127,6 +138,8 @@ class PushSumGossip(GossipAlgorithm):
     def post_step(self, params, state):
         phase = state.phase
         if not self.overlap:
+            if self.gossip_every > 1:
+                return self._thinned_post_step(params, state)
             params, ps_weight = self._mix(params, state.ps_weight, phase)
             ps_weight = jnp.reshape(jnp.asarray(ps_weight, jnp.float32),
                                     jnp.shape(state.ps_weight))
@@ -135,6 +148,29 @@ class PushSumGossip(GossipAlgorithm):
         # overlap: keep local share now, stash incoming for next pre_step
         (local_p, local_w), incoming = self._split_round(
             params, state.ps_weight, phase)
+        return self._finish_overlap(local_p, local_w, incoming, state,
+                                    phase)
+
+    def _thinned_post_step(self, params, state):
+        """Gossip on every ``gossip_every``-th call; the rotation phase
+        advances only when a round actually fires, so the graph cycles
+        through the same peer sequence as un-thinned gossip."""
+        tick = collectives.as_scalar(state.phase)
+        fire = (tick % self.gossip_every) == 0
+        rotation = tick // self.gossip_every
+
+        def mix_branch(operand):
+            p, w = operand
+            p, w = self._mix(p, w, rotation)
+            return p, jnp.reshape(jnp.asarray(w, jnp.float32),
+                                  jnp.shape(state.ps_weight))
+
+        params, ps_weight = jax.lax.cond(
+            fire, mix_branch, lambda o: o, (params, state.ps_weight))
+        return params, state.replace(phase=state.phase + 1,
+                                     ps_weight=ps_weight)
+
+    def _finish_overlap(self, local_p, local_w, incoming, state, phase):
         local_w = jnp.reshape(jnp.asarray(local_w, jnp.float32),
                               jnp.shape(state.ps_weight))
         return local_p, state.replace(phase=phase + 1,
@@ -198,8 +234,9 @@ def all_reduce(axis_name: str) -> AllReduce:
 
 
 def sgp(schedule: GossipSchedule, axis_name: str,
-        overlap: bool = False) -> PushSumGossip:
-    return PushSumGossip(schedule, axis_name, overlap=overlap)
+        overlap: bool = False, gossip_every: int = 1) -> PushSumGossip:
+    return PushSumGossip(schedule, axis_name, overlap=overlap,
+                         gossip_every=gossip_every)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str) -> PushSumGossip:

@@ -7,6 +7,7 @@ from .mesh import (
     make_gossip_mesh,
     make_hierarchical_mesh,
 )
+from .averaging import consensus_error, push_sum_average
 from .discovery import ClusterInfo, discover, initialize_multihost
 from .ring_attention import blockwise_attention, ring_attention
 from .collectives import (
@@ -35,4 +36,6 @@ __all__ = [
     "allreduce_sum",
     "ring_attention",
     "blockwise_attention",
+    "push_sum_average",
+    "consensus_error",
 ]

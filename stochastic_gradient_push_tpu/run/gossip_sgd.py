@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap", default="False", type=str)
     p.add_argument("--synch_freq", default=0, type=int,
                    help="accepted for compatibility; staleness is one step")
+    p.add_argument("--gossip_every", default=1, type=int,
+                   help="gossip on every k-th step only (communication "
+                        "thinning; sync push-sum mode)")
     p.add_argument("--warmup", default="False", type=str)
     p.add_argument("--seed", default=47, type=int)
     p.add_argument("--resume", default="False", type=str)
@@ -172,6 +175,7 @@ def parse_config(argv=None):
         num_classes=args.num_classes,
         scan_steps=args.scan_steps,
         num_dataloader_workers=args.num_dataloader_workers,
+        gossip_every=args.gossip_every,
     )
     return cfg, args
 

@@ -46,6 +46,8 @@ class TrainerConfig:
     all_reduce: bool = False
     push_sum: bool = True
     overlap: bool = False
+    # gossip on every k-th step (communication thinning, sync mode)
+    gossip_every: int = 1
     bilat: bool = False                       # AD-PSGD family
     graph_class: tp.Any = None                # GraphTopology subclass
     mixing_class: tp.Any = None               # MixingStrategy subclass
@@ -146,7 +148,10 @@ class Trainer:
         mixing = cfg.mixing_class() if cfg.mixing_class else None
         schedule = build_schedule(graph, mixing)
         if cfg.push_sum:
-            return sgp(schedule, axis, overlap=cfg.overlap)
+            return sgp(schedule, axis, overlap=cfg.overlap,
+                       gossip_every=cfg.gossip_every)
+        if cfg.gossip_every != 1:
+            raise ValueError("gossip_every is a push-sum knob")
         return dpsgd(schedule, axis, overlap=cfg.overlap)
 
     def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):

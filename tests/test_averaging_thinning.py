@@ -1,0 +1,129 @@
+"""Standalone averaging API and gossip_every communication thinning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.algorithms import sgp
+from stochastic_gradient_push_tpu.parallel import (
+    GOSSIP_AXIS,
+    consensus_error,
+    make_gossip_mesh,
+    push_sum_average,
+)
+from stochastic_gradient_push_tpu.topology import (
+    NPeerDynamicDirectedExponentialGraph,
+    SelfWeightedMixing,
+    build_schedule,
+)
+
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_gossip_mesh(WORLD)
+
+
+def test_push_sum_average_reaches_exact_mean(mesh):
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    rng = np.random.default_rng(0)
+    tree = {"a": rng.normal(size=(WORLD, 3, 2)).astype(np.float32),
+            "b": rng.normal(size=(WORLD, 5)).astype(np.float32)}
+    assert consensus_error(tree) > 0.5
+    out = push_sum_average(tree, mesh, sched, rounds=50)
+    assert consensus_error(out) < 1e-5
+    for k in tree:
+        want = tree[k].mean(axis=0)
+        np.testing.assert_allclose(np.asarray(out[k])[0], want,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_push_sum_average_irregular_mixing(mesh):
+    alphas = 0.3 + 0.5 * np.arange(WORLD) / (WORLD - 1)
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1),
+        SelfWeightedMixing(alpha=alphas))
+    rng = np.random.default_rng(1)
+    tree = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    out = push_sum_average(tree, mesh, sched, rounds=120)
+    np.testing.assert_allclose(
+        np.asarray(out), np.broadcast_to(tree.mean(0), tree.shape),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_gossip_every_thinned_sgp_matches_manual(mesh):
+    """gossip_every=2: odd steps are SGD-only, even steps gossip with the
+    rotation advancing once per fired round."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, gossip_every=2)
+    rng = np.random.default_rng(2)
+    x0 = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    targets = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    lr = 0.1
+
+    def step(params, gstate, target):
+        params, gstate = alg.pre_step(params, gstate)
+        z = alg.eval_params(params, gstate)
+        g = jax.grad(lambda p: 0.5 * jnp.sum((p - target) ** 2))(z)
+        return alg.post_step(params - lr * g, gstate)
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(GOSSIP_AXIS),) * 3,
+        out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+
+    params = x0.copy()
+    gstate = jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a),
+                                  (WORLD,) + np.shape(a)).copy(),
+        alg.init(jnp.zeros((4,), jnp.float32)))
+
+    sim = x0.astype(np.float64).copy()
+    for t in range(8):
+        params, gstate = jax.block_until_ready(f(params, gstate, targets))
+        sim = sim - lr * (sim - targets)
+        if t % 2 == 0:  # fired rounds: rotation t//2
+            sim = sched.mixing_matrix(t // 2) @ sim
+        np.testing.assert_allclose(np.asarray(params), sim,
+                                   rtol=1e-5, atol=1e-5, err_msg=str(t))
+
+
+def test_gossip_every_still_converges(mesh):
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, gossip_every=3)
+    rng = np.random.default_rng(3)
+    targets = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    lr = 0.02
+
+    def step(params, gstate, target):
+        params, gstate = alg.pre_step(params, gstate)
+        z = alg.eval_params(params, gstate)
+        g = jax.grad(lambda p: 0.5 * jnp.sum((p - target) ** 2))(z)
+        return alg.post_step(params - lr * g, gstate)
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(GOSSIP_AXIS),) * 3,
+        out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+    params = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    gstate = jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a),
+                                  (WORLD,) + np.shape(a)).copy(),
+        alg.init(jnp.zeros((4,), jnp.float32)))
+    for _ in range(600):
+        params, gstate = jax.block_until_ready(f(params, gstate, targets))
+    z = np.asarray(params) / np.asarray(gstate.ps_weight).reshape(WORLD, 1)
+    np.testing.assert_allclose(z.mean(0), targets.mean(0), atol=5e-3)
+
+
+def test_gossip_every_validation():
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    with pytest.raises(ValueError):
+        sgp(sched, GOSSIP_AXIS, gossip_every=0)
+    with pytest.raises(ValueError, match="overlap"):
+        sgp(sched, GOSSIP_AXIS, overlap=True, gossip_every=2)
