@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step only (communication "
                         "thinning; sync push-sum mode)")
+    p.add_argument("--cosine_lr", default="False", type=str,
+                   help="cosine LR decay instead of the step schedule")
+    p.add_argument("--label_smoothing", default=0.0, type=float)
+    p.add_argument("--grad_accum", default=1, type=int,
+                   help="microbatches accumulated per optimizer step")
     p.add_argument("--warmup", default="False", type=str)
     p.add_argument("--seed", default=47, type=int)
     p.add_argument("--resume", default="False", type=str)
@@ -176,6 +181,9 @@ def parse_config(argv=None):
         scan_steps=args.scan_steps,
         num_dataloader_workers=args.num_dataloader_workers,
         gossip_every=args.gossip_every,
+        cosine_lr=_str_bool(args.cosine_lr),
+        label_smoothing=args.label_smoothing,
+        grad_accum=args.grad_accum,
     )
     return cfg, args
 

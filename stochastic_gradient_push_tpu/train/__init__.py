@@ -1,6 +1,6 @@
 """Training harness: state, steps, schedules, metrics."""
 
-from .lr import LRSchedule, ppi_at_epoch
+from .lr import CosineLRSchedule, LRSchedule, ppi_at_epoch
 from .metrics import accuracy_topk, kl_div_loss, one_hot
 from .state import TrainState, init_train_state, sgd
 from .step import (
@@ -16,6 +16,7 @@ from .step import (
 
 __all__ = [
     "LRSchedule",
+    "CosineLRSchedule",
     "ppi_at_epoch",
     "accuracy_topk",
     "kl_div_loss",

@@ -22,7 +22,7 @@ from ..parallel.mesh import GOSSIP_AXIS, LOCAL_AXIS, NODE_AXIS
 from ..topology import build_pairing_schedule, build_schedule
 from ..utils import Meter, make_logger
 from ..utils.checkpoint import ClusterManager
-from .lr import LRSchedule, ppi_at_epoch
+from .lr import CosineLRSchedule, LRSchedule, ppi_at_epoch
 from .state import init_train_state, sgd
 from .step import (
     build_eval_step,
@@ -62,6 +62,9 @@ class TrainerConfig:
     lr_schedule: dict[int, float] = dataclasses.field(
         default_factory=lambda: {30: 0.1, 60: 0.1, 80: 0.1})
     warmup: bool = False
+    cosine_lr: bool = False                   # cosine decay instead of steps
+    label_smoothing: float = 0.0
+    grad_accum: int = 1
 
     # run shape
     batch_size: int = 32                      # per-rank
@@ -165,7 +168,9 @@ class Trainer:
             step = build_train_step(
                 self.model, alg, self.tx, self.lr_schedule_obj,
                 itr_per_epoch=itr_per_epoch, num_classes=self.cfg.num_classes,
-                local_axis=self.local_axis)
+                local_axis=self.local_axis,
+                label_smoothing=self.cfg.label_smoothing,
+                grad_accum=self.cfg.grad_accum)
             if scan > 1:
                 fn = shard_scanned_train_step(
                     step, self.mesh, scan, self.gossip_axis,
@@ -230,10 +235,16 @@ class Trainer:
         cap = cfg.num_iterations_per_training_epoch
         if cap not in (None, -1):
             itr_per_epoch = min(itr_per_epoch, cap)
-        self.lr_schedule_obj = LRSchedule(
-            ref_lr=cfg.lr, batch_size=cfg.batch_size,
-            world_size=self.world_size, decay_schedule=cfg.lr_schedule,
-            warmup=cfg.warmup)
+        if cfg.cosine_lr:
+            self.lr_schedule_obj = CosineLRSchedule(
+                ref_lr=cfg.lr, batch_size=cfg.batch_size,
+                world_size=self.world_size, total_epochs=cfg.num_epochs,
+                warmup=cfg.warmup)
+        else:
+            self.lr_schedule_obj = LRSchedule(
+                ref_lr=cfg.lr, batch_size=cfg.batch_size,
+                world_size=self.world_size, decay_schedule=cfg.lr_schedule,
+                warmup=cfg.warmup)
         self._init_csv()
 
         batch_meter = Meter(ptag="Time")

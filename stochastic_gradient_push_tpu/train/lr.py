@@ -17,12 +17,30 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["LRSchedule", "ppi_at_epoch"]
+__all__ = ["LRSchedule", "CosineLRSchedule", "ppi_at_epoch"]
 
 WARMUP_EPOCHS = 5
 
 
-class LRSchedule:
+class _ScaledWarmupSchedule:
+    """Shared machinery: global-batch LR scaling + linear warmup ramp."""
+
+    def __init__(self, ref_lr: float, batch_size: int, world_size: int,
+                 warmup: bool, scale: float = 1.0):
+        self.ref_lr = float(ref_lr)
+        self.target_lr = float(
+            ref_lr * batch_size * scale * world_size / 256.0)
+        self.warmup = bool(warmup)
+
+    def _warmup_ramp(self, epoch, itr, itr_per_epoch):
+        """Linear ramp ref_lr → target_lr over WARMUP_EPOCHS epochs
+        (gossip_sgd.py:519-526)."""
+        count = epoch * itr_per_epoch + itr + 1.0
+        return self.ref_lr + (self.target_lr - self.ref_lr) * (
+            count / (WARMUP_EPOCHS * itr_per_epoch))
+
+
+class LRSchedule(_ScaledWarmupSchedule):
     """Callable ``(epoch, itr, itr_per_epoch) -> lr`` matching
     ``update_learning_rate`` (gossip_sgd.py:508-536).
 
@@ -39,13 +57,10 @@ class LRSchedule:
     def __init__(self, ref_lr: float, batch_size: int, world_size: int,
                  decay_schedule: dict[int, float] | None = None,
                  warmup: bool = False, scale: float = 1.0):
+        super().__init__(ref_lr, batch_size, world_size, warmup, scale)
         if decay_schedule is None:
             decay_schedule = {30: 0.1, 60: 0.1, 80: 0.1}
-        self.ref_lr = float(ref_lr)
-        self.target_lr = float(
-            ref_lr * batch_size * scale * world_size / 256.0)
         self.decay_schedule = dict(sorted(decay_schedule.items()))
-        self.warmup = bool(warmup)
 
     def __call__(self, epoch, itr, itr_per_epoch):
         """LR for a (possibly traced) position in training."""
@@ -62,11 +77,32 @@ class LRSchedule:
             if self.target_lr <= self.ref_lr:
                 warm = jnp.float32(self.target_lr)
             else:
-                count = epoch * itr_per_epoch + itr + 1.0
-                incr = (self.target_lr - self.ref_lr) * (
-                    count / (WARMUP_EPOCHS * itr_per_epoch))
-                warm = self.ref_lr + incr
+                warm = self._warmup_ramp(epoch, itr, itr_per_epoch)
             lr = jnp.where(epoch < WARMUP_EPOCHS, warm, lr)
+        return lr
+
+
+class CosineLRSchedule(_ScaledWarmupSchedule):
+    """Cosine decay to zero over ``total_epochs`` with the same linear
+    warmup and global-batch scaling as :class:`LRSchedule` — the modern
+    recipe the reference predates, for beyond-parity runs."""
+
+    def __init__(self, ref_lr: float, batch_size: int, world_size: int,
+                 total_epochs: int, warmup: bool = True,
+                 scale: float = 1.0):
+        super().__init__(ref_lr, batch_size, world_size, warmup, scale)
+        self.total_epochs = int(total_epochs)
+
+    def __call__(self, epoch, itr, itr_per_epoch):
+        epoch = jnp.asarray(epoch, jnp.float32)
+        itr = jnp.asarray(itr, jnp.float32)
+        itr_per_epoch = jnp.asarray(itr_per_epoch, jnp.float32)
+        progress = (epoch + itr / itr_per_epoch) / self.total_epochs
+        progress = jnp.clip(progress, 0.0, 1.0)
+        lr = self.target_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        if self.warmup and self.target_lr > self.ref_lr:
+            warm = self._warmup_ramp(epoch, itr, itr_per_epoch)
+            lr = jnp.where(epoch < WARMUP_EPOCHS, jnp.minimum(warm, lr), lr)
         return lr
 
 

@@ -15,9 +15,16 @@ import jax.numpy as jnp
 __all__ = ["kl_div_loss", "one_hot", "accuracy_topk"]
 
 
-def one_hot(labels: jnp.ndarray, num_classes: int) -> jnp.ndarray:
-    """One-hot targets (≙ the scatter_ at gossip_sgd.py:372-373)."""
-    return jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+def one_hot(labels: jnp.ndarray, num_classes: int,
+            label_smoothing: float = 0.0) -> jnp.ndarray:
+    """One-hot targets (≙ the scatter_ at gossip_sgd.py:372-373), with
+    optional label smoothing — soft targets flow through the same KLDiv
+    loss the reference chose precisely to allow them."""
+    targets = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    if label_smoothing:
+        targets = (targets * (1.0 - label_smoothing)
+                   + label_smoothing / num_classes)
+    return targets
 
 
 def kl_div_loss(logits: jnp.ndarray, kl_target: jnp.ndarray) -> jnp.ndarray:

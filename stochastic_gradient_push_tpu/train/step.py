@@ -41,7 +41,9 @@ __all__ = ["build_train_step", "build_eval_step", "shard_train_step",
 
 def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                      itr_per_epoch: int, num_classes: int,
-                     local_axis: str | None = None) -> tp.Callable:
+                     local_axis: str | None = None,
+                     label_smoothing: float = 0.0,
+                     grad_accum: int = 1) -> tp.Callable:
     """Returns the per-rank step ``(state, images, labels) -> (state, metrics)``.
 
     Call inside ``shard_map`` (see :func:`shard_train_step`), or directly for
@@ -57,21 +59,63 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
       local_axis: optional intra-node mesh axis; gradients and BN stats are
         exactly averaged over it (≙ nprocs_per_node local all-reduce,
         distributed.py:551-562 and BN buffer sync :269-276).
+      label_smoothing: soft-target smoothing through the KLDiv loss.
+      grad_accum: split each batch into this many microbatches and
+        accumulate gradients before the optimizer step — 1/grad_accum peak
+        activation memory.  Exactly equivalent for BN-free models; with
+        BatchNorm, normalization statistics are per-microbatch and the
+        running-stats EMA advances once per microbatch, so dynamics differ
+        slightly from the full batch (as with any microbatched BN).
     """
+    if grad_accum < 1:
+        raise ValueError("grad_accum must be >= 1")
 
     def train_step(state: TrainState, images, labels):
         params, gstate = algorithm.pre_step(state.params, state.gossip)
         z = algorithm.eval_params(params, gstate)
 
-        def loss_fn(p):
+        def loss_fn(p, x, y, batch_stats):
             out, mutated = model.apply(
-                {"params": p, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"])
-            loss = kl_div_loss(out, one_hot(labels, num_classes))
+                {"params": p, "batch_stats": batch_stats},
+                x, train=True, mutable=["batch_stats"])
+            loss = kl_div_loss(
+                out, one_hot(y, num_classes, label_smoothing))
             return loss, (out, mutated["batch_stats"])
 
-        (loss, (logits, batch_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(z)
+        if grad_accum == 1:
+            (loss, (logits, batch_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(z, images, labels,
+                                       state.batch_stats)
+            top1, top5 = accuracy_topk(logits, labels, topk=(1, 5))
+        else:
+            b = images.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum {grad_accum}")
+            micro = b // grad_accum
+            xs = images.reshape((grad_accum, micro) + images.shape[1:])
+            ys = labels.reshape((grad_accum, micro) + labels.shape[1:])
+
+            def accum(carry, xy):
+                g_sum, loss_sum, t1_sum, t5_sum, bstats = carry
+                x, y = xy
+                (l, (out, bstats)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(z, x, y, bstats)
+                a1, a5 = accuracy_topk(out, y, topk=(1, 5))
+                return (jax.tree.map(jnp.add, g_sum, g), loss_sum + l,
+                        t1_sum + a1, t5_sum + a5, bstats), None
+
+            zero_g = jax.tree.map(jnp.zeros_like, z)
+            # scalar accumulators derive from the (device-varying) images so
+            # the scan carry type matches the body outputs (vma rules)
+            zero_s = jnp.sum(images * 0.0).astype(jnp.float32)
+            (g_sum, loss_sum, t1_sum, t5_sum, batch_stats), _ = lax.scan(
+                accum, (zero_g, zero_s, zero_s, zero_s,
+                        state.batch_stats), (xs, ys))
+            grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
+            loss = loss_sum / grad_accum
+            top1 = t1_sum / grad_accum
+            top5 = t5_sum / grad_accum
 
         if local_axis is not None:
             # exact intra-node averaging of gradients and BN statistics
@@ -97,7 +141,6 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
         params, gstate = algorithm.post_step(params, gstate)
 
-        top1, top5 = accuracy_topk(logits, labels, topk=(1, 5))
         metrics = {"loss": loss, "top1": top1, "top5": top5, "lr": lr}
         if local_axis is not None:
             metrics = jax.tree.map(

@@ -1,0 +1,108 @@
+"""Label smoothing, cosine LR, and gradient accumulation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.algorithms import sgp
+from stochastic_gradient_push_tpu.models import TinyMLP
+from stochastic_gradient_push_tpu.parallel import GOSSIP_AXIS, make_gossip_mesh
+from stochastic_gradient_push_tpu.topology import (
+    NPeerDynamicDirectedExponentialGraph,
+    build_schedule,
+)
+from stochastic_gradient_push_tpu.train import (
+    CosineLRSchedule,
+    LRSchedule,
+    build_train_step,
+    init_train_state,
+    one_hot,
+    replicate_state,
+    sgd,
+    shard_train_step,
+)
+
+WORLD, BATCH, CLASSES, IMG = 8, 8, 4, 8
+
+
+def test_label_smoothing_targets():
+    t = one_hot(jnp.asarray([1]), 4, label_smoothing=0.1)
+    np.testing.assert_allclose(
+        np.asarray(t)[0], [0.025, 0.925, 0.025, 0.025], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t).sum(), 1.0, rtol=1e-6)
+
+
+def test_cosine_schedule_shape():
+    s = CosineLRSchedule(ref_lr=0.1, batch_size=256, world_size=32,
+                         total_epochs=90, warmup=True)
+    target = 0.1 * 256 * 32 / 256
+    ipe = 100
+    # warmup ramps from ref_lr
+    assert float(s(0, 0, ipe)) < target / 2
+    # mid-training is between 0 and target, decreasing
+    mid = float(s(45, 0, ipe))
+    late = float(s(80, 0, ipe))
+    assert 0 < late < mid < target
+    # end decays to ~0
+    assert float(s(89, 99, ipe)) < 0.01 * target
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_gossip_mesh(WORLD)
+
+
+def test_grad_accum_matches_full_batch(mesh):
+    """grad_accum=4 must produce the same update as the full batch (modulo
+    BN statistics, absent in TinyMLP)."""
+    model = TinyMLP(num_classes=CLASSES)
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    tx = sgd(momentum=0.9, weight_decay=1e-4)
+    lrs = LRSchedule(ref_lr=0.1, batch_size=BATCH, world_size=WORLD,
+                     decay_schedule={}, warmup=False)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(WORLD, BATCH, IMG, IMG, 3)).astype(np.float32)
+    y = rng.integers(0, CLASSES, size=(WORLD, BATCH)).astype(np.int32)
+
+    states = []
+    for accum in (1, 4):
+        alg = sgp(sched, GOSSIP_AXIS)
+        step = build_train_step(model, alg, tx, lrs, itr_per_epoch=10,
+                                num_classes=CLASSES, grad_accum=accum)
+        fn = shard_train_step(step, mesh)
+        st = replicate_state(
+            init_train_state(model, jax.random.PRNGKey(0),
+                             jnp.zeros((BATCH, IMG, IMG, 3)), tx, alg),
+            WORLD)
+        st, metrics = fn(st, x, y)
+        jax.block_until_ready(st)
+        states.append((st, float(np.mean(np.asarray(metrics["loss"])))))
+
+    (s1, l1), (s4, l4) = states
+    np.testing.assert_allclose(l1, l4, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_divisibility_error(mesh):
+    model = TinyMLP(num_classes=CLASSES)
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    tx = sgd()
+    lrs = LRSchedule(ref_lr=0.1, batch_size=BATCH, world_size=WORLD,
+                     decay_schedule={}, warmup=False)
+    alg = sgp(sched, GOSSIP_AXIS)
+    step = build_train_step(model, alg, tx, lrs, itr_per_epoch=10,
+                            num_classes=CLASSES, grad_accum=3)
+    fn = shard_train_step(step, mesh)
+    st = replicate_state(
+        init_train_state(model, jax.random.PRNGKey(0),
+                         jnp.zeros((BATCH, IMG, IMG, 3)), tx, alg), WORLD)
+    x = np.zeros((WORLD, BATCH, IMG, IMG, 3), np.float32)
+    y = np.zeros((WORLD, BATCH), np.int32)
+    with pytest.raises(ValueError, match="divisible"):
+        fn(st, x, y)
