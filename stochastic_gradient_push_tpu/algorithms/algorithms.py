@@ -60,7 +60,7 @@ class PushSumGossip(GossipAlgorithm):
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, track_weight: bool = True,
-                 gossip_every: int = 1):
+                 gossip_every: int = 1, comm_dtype=None):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
@@ -76,6 +76,8 @@ class PushSumGossip(GossipAlgorithm):
                 "gossip_every > 1 is a synchronous-mode knob; overlap "
                 "already hides the collective behind compute")
         self.gossip_every = gossip_every
+        # wire-compression dtype for gossip payloads (e.g. jnp.bfloat16)
+        self.comm_dtype = comm_dtype
 
     # -- helpers -----------------------------------------------------------
 
@@ -85,9 +87,11 @@ class PushSumGossip(GossipAlgorithm):
     def _mix(self, params, ps_weight, phase):
         if self.track_weight:
             return collectives.mix_push_sum(
-                params, ps_weight, phase, self.schedule, self.axis_name)
+                params, ps_weight, phase, self.schedule, self.axis_name,
+                comm_dtype=self.comm_dtype)
         return (collectives.mix_push_pull(
-            params, phase, self.schedule, self.axis_name), ps_weight)
+            params, phase, self.schedule, self.axis_name,
+            comm_dtype=self.comm_dtype), ps_weight)
 
     def _split_round(self, params, ps_weight, phase):
         """One round split into (local share, incoming share).
@@ -98,7 +102,8 @@ class PushSumGossip(GossipAlgorithm):
         """
         tree = (params, ps_weight)
         mixed = collectives.gossip_round(
-            tree, phase, self.schedule, self.axis_name)
+            tree, phase, self.schedule, self.axis_name,
+            comm_dtype=self.comm_dtype)
         # local share is a cheap rescale; recover incoming by subtraction
         # would lose precision — instead compute local share directly and
         # subtract from the mixed total.
@@ -234,9 +239,10 @@ def all_reduce(axis_name: str) -> AllReduce:
 
 
 def sgp(schedule: GossipSchedule, axis_name: str,
-        overlap: bool = False, gossip_every: int = 1) -> PushSumGossip:
+        overlap: bool = False, gossip_every: int = 1,
+        comm_dtype=None) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
-                         gossip_every=gossip_every)
+                         gossip_every=gossip_every, comm_dtype=comm_dtype)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str) -> PushSumGossip:

@@ -71,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label_smoothing", default=0.0, type=float)
     p.add_argument("--grad_accum", default=1, type=int,
                    help="microbatches accumulated per optimizer step")
+    p.add_argument("--gossip_comm_dtype", default=None,
+                   choices=[None, "bf16"],
+                   help="compress gossip wire payloads to bf16 "
+                        "(half the ICI traffic, bounded quantization error)")
     p.add_argument("--warmup", default="False", type=str)
     p.add_argument("--seed", default=47, type=int)
     p.add_argument("--resume", default="False", type=str)
@@ -184,6 +188,7 @@ def parse_config(argv=None):
         cosine_lr=_str_bool(args.cosine_lr),
         label_smoothing=args.label_smoothing,
         grad_accum=args.grad_accum,
+        gossip_comm_dtype=args.gossip_comm_dtype,
     )
     return cfg, args
 

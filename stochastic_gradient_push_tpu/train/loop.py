@@ -48,6 +48,9 @@ class TrainerConfig:
     overlap: bool = False
     # gossip on every k-th step (communication thinning, sync mode)
     gossip_every: int = 1
+    # wire dtype for gossip payloads: None = leaf dtype, "bf16" halves
+    # ICI traffic with bounded quantization error
+    gossip_comm_dtype: str | None = None
     bilat: bool = False                       # AD-PSGD family
     graph_class: tp.Any = None                # GraphTopology subclass
     mixing_class: tp.Any = None               # MixingStrategy subclass
@@ -140,9 +143,26 @@ class Trainer:
 
     # -- algorithm / step construction ------------------------------------
 
+    def _comm_dtype(self):
+        """Resolve the wire-compression dtype; reject unknown values rather
+        than silently running uncompressed."""
+        v = self.cfg.gossip_comm_dtype
+        if v is None:
+            return None
+        if v == "bf16":
+            import jax.numpy as jnp
+
+            return jnp.bfloat16
+        raise ValueError(f"unknown gossip_comm_dtype {v!r}; use 'bf16'")
+
     def make_algorithm(self, ppi: int) -> GossipAlgorithm:
         cfg = self.cfg
         axis = self.gossip_axis
+        if (cfg.gossip_comm_dtype is not None
+                and (cfg.all_reduce or cfg.bilat or not cfg.push_sum)):
+            raise ValueError(
+                "gossip_comm_dtype currently applies to the push-sum "
+                "family only")
         if cfg.all_reduce:
             return all_reduce(axis)
         graph = cfg.graph_class(self.gossip_world, peers_per_itr=ppi)
@@ -152,7 +172,8 @@ class Trainer:
         schedule = build_schedule(graph, mixing)
         if cfg.push_sum:
             return sgp(schedule, axis, overlap=cfg.overlap,
-                       gossip_every=cfg.gossip_every)
+                       gossip_every=cfg.gossip_every,
+                       comm_dtype=self._comm_dtype())
         if cfg.gossip_every != 1:
             raise ValueError("gossip_every is a push-sum knob")
         return dpsgd(schedule, axis, overlap=cfg.overlap)
