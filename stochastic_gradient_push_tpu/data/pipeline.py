@@ -22,8 +22,6 @@ Mirrors the reference's data plumbing (gossip_sgd.py:539-583):
 
 from __future__ import annotations
 
-import typing as tp
-
 import numpy as np
 
 __all__ = ["DistributedSampler", "ShardedLoader",

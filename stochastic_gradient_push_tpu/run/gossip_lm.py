@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--graph_type", default=5, type=int,
                    choices=list(GRAPH_TOPOLOGIES))
     p.add_argument("--peers_per_itr", default=1, type=int)
+    p.add_argument("--gossip_every", default=1, type=int,
+                   help="gossip on every k-th step (communication thinning)")
+    p.add_argument("--gossip_comm_dtype", default=None,
+                   choices=[None, "bf16"],
+                   help="compress gossip wire payloads to bf16")
     # optimization
     p.add_argument("--lr", default=0.5, type=float)
     p.add_argument("--momentum", default=0.9, type=float)
@@ -124,13 +129,24 @@ def main(argv=None):
     model = TransformerLM(cfg)
 
     if sb(args.all_reduce):
+        if args.gossip_every != 1 or args.gossip_comm_dtype:
+            raise SystemExit(
+                "gossip_every/gossip_comm_dtype are push-sum knobs")
         alg = all_reduce(GOSSIP_AXIS)
     else:
         graph = GRAPH_TOPOLOGIES[args.graph_type](
             dp, peers_per_itr=args.peers_per_itr)
         schedule = build_schedule(graph)
-        maker = sgp if sb(args.push_sum) else dpsgd
-        alg = maker(schedule, GOSSIP_AXIS, overlap=sb(args.overlap))
+        if sb(args.push_sum):
+            comm_dtype = (jnp.bfloat16 if args.gossip_comm_dtype == "bf16"
+                          else None)
+            alg = sgp(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
+                      gossip_every=args.gossip_every, comm_dtype=comm_dtype)
+        else:
+            if args.gossip_every != 1 or args.gossip_comm_dtype:
+                raise SystemExit(
+                    "gossip_every/gossip_comm_dtype are push-sum knobs")
+            alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap))
 
     tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
              nesterov=sb(args.nesterov))

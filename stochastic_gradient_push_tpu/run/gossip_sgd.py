@@ -209,8 +209,7 @@ def main(argv=None, config_transform=None, extra_args=None):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     from ..data import (DistributedSampler, ShardedLoader,
-                        StreamingImageFolder, imagefolder_arrays,
-                        synthetic_classification)
+                        StreamingImageFolder, synthetic_classification)
     from ..models import RESNETS, TinyCNN
     from ..parallel import make_gossip_mesh, make_hierarchical_mesh
     from ..train.loop import Trainer

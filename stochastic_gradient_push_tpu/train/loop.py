@@ -134,7 +134,6 @@ class Trainer:
         # second can recompile again because donation turns the host-numpy
         # state of call 1 into device-sharded arrays from call 2 on
         self._warm_counts: dict = {}
-        self._current_ppi: int | None = None
         self._eval_fn = None
 
         self.out_fname = os.path.join(

@@ -15,7 +15,6 @@ from __future__ import annotations
 import os
 import re
 
-import numpy as np
 import pandas as pd
 
 __all__ = ["parse_csv", "parse_transformer_out", "plot_itrs",
