@@ -93,11 +93,10 @@ def main(argv=None):
     from ..models.transformer import TransformerConfig, TransformerLM
     from ..parallel import GOSSIP_AXIS
     from ..topology import build_schedule
-    from ..train import LRSchedule, replicate_state, sgd
-    from ..train.lm import (SEQ_AXIS, build_lm_train_step, make_dp_sp_mesh,
-                            shard_lm_train_step)
+    from ..train import LRSchedule, sgd
+    from ..train.lm import (SEQ_AXIS, build_lm_train_step, init_lm_state,
+                            make_dp_sp_mesh, shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
-    from ..train.state import TrainState
     from ..utils import Meter, make_logger
     from .gossip_sgd import _str_bool as sb
 
@@ -165,31 +164,15 @@ def main(argv=None):
     train_fn = shard_lm_train_step(
         step, mesh, seq_axis=SEQ_AXIS if attn == "ring" else None)
 
-    block = args.seq_len // sp
-    from jax.sharding import PartitionSpec as P
-
-    def init_fn(toks):
-        t = toks[0, 0] if attn == "ring" else toks[0]
-        variables = model.init(jax.random.PRNGKey(args.seed), t)
-        return jax.tree.map(lambda a: a[None], variables["params"])
-
-    batch_spec = (P(GOSSIP_AXIS, SEQ_AXIS) if attn == "ring"
-                  else P(GOSSIP_AXIS))
-    init_sharded = jax.jit(jax.shard_map(
-        init_fn, mesh=mesh, in_specs=(batch_spec,),
-        out_specs=P(GOSSIP_AXIS)))
-    dummy_shape = ((dp, sp, args.batch_size, block) if attn == "ring"
-                   else (dp, args.batch_size, args.seq_len))
-    params = init_sharded(np.zeros(dummy_shape, np.int32))
-
-    one = lambda t: jax.tree.map(lambda a: a[0], t)
-    state = TrainState(
-        step=jnp.zeros((dp,), jnp.int32), params=params, batch_stats={},
-        opt_state=replicate_state(tx.init(one(params)), dp),
-        gossip=replicate_state(alg.init(one(params)), dp))
+    ring = attn == "ring"
+    state = init_lm_state(
+        model, mesh, alg, tx, dp=dp, sp=sp, batch_size=args.batch_size,
+        block_len=args.seq_len // sp if ring else args.seq_len,
+        seed=args.seed, seq_axis=SEQ_AXIS if ring else None)
 
     n_params = sum(int(np.prod(np.shape(l)))
-                   for l in jax.tree.leaves(one(params)))
+                   for l in jax.tree.leaves(
+                       jax.tree.map(lambda a: a[0], state.params)))
     log.info(f"mesh {mesh}; {n_params/1e6:.2f}M params; attn={attn}")
 
     corpus = synthetic_lm_corpus(args.corpus_tokens,

@@ -36,7 +36,7 @@ from .state import TrainState
 SEQ_AXIS = "seq"
 
 __all__ = ["SEQ_AXIS", "make_dp_sp_mesh", "build_lm_train_step",
-           "shard_lm_train_step", "lm_loss"]
+           "shard_lm_train_step", "lm_loss", "init_lm_state"]
 
 
 def make_dp_sp_mesh(dp: int, sp: int, devices=None) -> Mesh:
@@ -123,3 +123,36 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
         in_specs=(P(gossip_axis), batch_spec, batch_spec),
         out_specs=(P(gossip_axis), P(gossip_axis)))
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def init_lm_state(model, mesh, algorithm, tx, dp: int, sp: int,
+                  batch_size: int, block_len: int, seed: int = 0,
+                  gossip_axis: str = GOSSIP_AXIS,
+                  seq_axis: str | None = SEQ_AXIS) -> TrainState:
+    """Build the gossip-stacked LM train state.
+
+    Ring-attention models reference the mesh axis, so parameter init runs
+    under ``shard_map``; optimizer and gossip state replicate over the
+    gossip dimension.  Shared by the LM CLI and the multi-chip dry run.
+    """
+    from .step import replicate_state
+
+    ring = seq_axis is not None
+    batch_spec = P(gossip_axis, seq_axis) if ring else P(gossip_axis)
+
+    def init_fn(toks):
+        t = toks[0, 0] if ring else toks[0]
+        variables = model.init(jax.random.PRNGKey(seed), t)
+        return jax.tree.map(lambda a: a[None], variables["params"])
+
+    init_sharded = jax.jit(jax.shard_map(
+        init_fn, mesh=mesh, in_specs=(batch_spec,),
+        out_specs=P(gossip_axis)))
+    dummy_shape = ((dp, sp, batch_size, block_len) if ring
+                   else (dp, batch_size, block_len))
+    params = init_sharded(np.zeros(dummy_shape, np.int32))
+    one = lambda t: jax.tree.map(lambda a: a[0], t)
+    return TrainState(
+        step=jnp.zeros((dp,), jnp.int32), params=params, batch_stats={},
+        opt_state=replicate_state(tx.init(one(params)), dp),
+        gossip=replicate_state(algorithm.init(one(params)), dp))

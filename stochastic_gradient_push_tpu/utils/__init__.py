@@ -5,6 +5,7 @@ from .flatten import (
     flatten_tensors,
     global_norm,
     group_by_dtype,
+    is_power_of,
     unflatten_tensors,
 )
 from .logging import make_logger
@@ -19,6 +20,7 @@ __all__ = [
     "group_by_dtype",
     "communicate",
     "global_norm",
+    "is_power_of",
     "StepWatchdog",
     "trace",
     "HEARTBEAT_TIMEOUT",

@@ -13,6 +13,7 @@ buffers).
 from __future__ import annotations
 
 import collections
+import math
 import typing as tp
 
 import jax
@@ -20,7 +21,7 @@ import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
 __all__ = ["flatten_tensors", "unflatten_tensors", "group_by_dtype",
-           "communicate", "global_norm"]
+           "communicate", "global_norm", "is_power_of"]
 
 
 def flatten_tensors(tree) -> tuple[jnp.ndarray, tp.Callable]:
@@ -67,3 +68,12 @@ def global_norm(tree) -> jnp.ndarray:
     """L2 norm over all leaves (handy for gossip-disagreement metrics)."""
     flat, _ = ravel_pytree(tree)
     return jnp.linalg.norm(flat)
+
+
+def is_power_of(n: int, k: int) -> bool:
+    """Whether ``n`` is a power of ``k`` (≙ helpers.py:117-128)."""
+    if not (isinstance(n, int) and isinstance(k, int)) or k < 0 or n <= 0:
+        raise ValueError("n must be a positive int, k a non-negative int")
+    if k <= 1:
+        return n == 1
+    return k ** int(round(math.log(n, k))) == n
