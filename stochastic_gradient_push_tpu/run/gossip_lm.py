@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--world_size", default=None, type=int)
     p.add_argument("--sp", default=1, type=int,
                    help="sequence-parallel shards per replica")
+    p.add_argument("--tp", default=1, type=int,
+                   help="tensor-parallel shards per replica (Megatron "
+                        "kernel sharding via GSPMD; incompatible with --sp)")
     p.add_argument("--batch_size", default=8, type=int,
                    help="sequences per replica per step")
     p.add_argument("--num_steps", default=1000, type=int)
@@ -94,8 +97,10 @@ def main(argv=None):
     from ..parallel import GOSSIP_AXIS
     from ..topology import build_schedule
     from ..train import LRSchedule, sgd
-    from ..train.lm import (SEQ_AXIS, build_lm_train_step, init_lm_state,
-                            make_dp_sp_mesh, shard_lm_train_step)
+    from ..train.lm import (SEQ_AXIS, apply_tp_sharding,
+                            build_lm_train_step, init_lm_state,
+                            make_dp_sp_mesh, make_dp_tp_mesh,
+                            shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
     from ..utils import Meter, make_logger
     from .gossip_sgd import _str_bool as sb
@@ -103,13 +108,19 @@ def main(argv=None):
     log = make_logger("lm", True)
 
     world = args.world_size or jax.device_count()
-    sp = args.sp
-    if world % sp:
-        raise SystemExit(f"world_size {world} not divisible by sp {sp}")
-    dp = world // sp
+    sp, tp = args.sp, args.tp
+    if sp < 1 or tp < 1:
+        raise SystemExit("--sp and --tp must be >= 1")
+    if sp > 1 and tp > 1:
+        raise SystemExit("--sp and --tp cannot be combined yet")
+    if world % (sp * tp):
+        raise SystemExit(
+            f"world_size {world} not divisible by sp*tp {sp * tp}")
+    dp = world // (sp * tp)
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
-    mesh = make_dp_sp_mesh(dp, sp)
+    mesh = (make_dp_tp_mesh(dp, tp) if tp > 1
+            else make_dp_sp_mesh(dp, sp))
 
     attn = args.attn
     if attn is None:
@@ -117,6 +128,8 @@ def main(argv=None):
             "flash" if jax.default_backend() == "tpu" else "full")
     if sp > 1 and attn != "ring":
         raise SystemExit("--sp > 1 requires ring attention")
+    if tp > 1 and attn == "ring":
+        raise SystemExit("--tp cannot be combined with ring attention")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -162,13 +175,22 @@ def main(argv=None):
         model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
         seq_axis=SEQ_AXIS if attn == "ring" else None)
     train_fn = shard_lm_train_step(
-        step, mesh, seq_axis=SEQ_AXIS if attn == "ring" else None)
+        step, mesh, seq_axis=SEQ_AXIS if attn == "ring" else None,
+        tp=tp > 1)
 
     ring = attn == "ring"
-    state = init_lm_state(
-        model, mesh, alg, tx, dp=dp, sp=sp, batch_size=args.batch_size,
-        block_len=args.seq_len // sp if ring else args.seq_len,
-        seed=args.seed, seq_axis=SEQ_AXIS if ring else None)
+    if tp > 1:
+        from ..train.lm import init_lm_state_tp
+
+        state = init_lm_state_tp(model, mesh, alg, tx, dp=dp,
+                                 batch_size=args.batch_size,
+                                 seq_len=args.seq_len, seed=args.seed)
+    else:
+        state = init_lm_state(
+            model, mesh, alg, tx, dp=dp, sp=sp,
+            batch_size=args.batch_size,
+            block_len=args.seq_len // sp if ring else args.seq_len,
+            seed=args.seed, seq_axis=SEQ_AXIS if ring else None)
 
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree.leaves(

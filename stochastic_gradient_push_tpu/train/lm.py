@@ -34,19 +34,106 @@ from ..parallel.mesh import GOSSIP_AXIS
 from .state import TrainState
 
 SEQ_AXIS = "seq"
+TP_AXIS = "tp"
 
-__all__ = ["SEQ_AXIS", "make_dp_sp_mesh", "build_lm_train_step",
-           "shard_lm_train_step", "lm_loss", "init_lm_state"]
+__all__ = ["SEQ_AXIS", "TP_AXIS", "make_dp_sp_mesh", "make_dp_tp_mesh",
+           "build_lm_train_step", "shard_lm_train_step", "lm_loss",
+           "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
+           "init_lm_state_tp"]
+
+
+def _make_2d_mesh(dp: int, n: int, second_axis: str, devices) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < dp * n:
+        raise ValueError(f"need {dp * n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:dp * n]).reshape(dp, n)
+    return Mesh(grid, (GOSSIP_AXIS, second_axis))
 
 
 def make_dp_sp_mesh(dp: int, sp: int, devices=None) -> Mesh:
     """2-D ``(gossip, seq)`` mesh: dp model replicas × sp sequence shards."""
-    if devices is None:
-        devices = jax.devices()
-    if len(devices) < dp * sp:
-        raise ValueError(f"need {dp * sp} devices, have {len(devices)}")
-    grid = np.asarray(devices[:dp * sp]).reshape(dp, sp)
-    return Mesh(grid, (GOSSIP_AXIS, SEQ_AXIS))
+    return _make_2d_mesh(dp, sp, SEQ_AXIS, devices)
+
+
+def make_dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    """2-D ``(gossip, tp)`` mesh: dp gossip replicas × tp-way tensor
+    parallelism inside each replica."""
+    return _make_2d_mesh(dp, tp, TP_AXIS, devices)
+
+
+# transformer modules whose kernels shard over the tp axis: column-parallel
+# (output features split) then row-parallel (input features split), the
+# Megatron pattern — GSPMD inserts the reduction after o/down projections
+_TP_COLUMN = {"q", "k", "v", "up", "lm_head"}
+_TP_ROW = {"o", "down"}
+
+
+def tp_sharding_tree(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
+                     tp_axis: str = TP_AXIS):
+    """NamedShardings for a gossip-stacked LM tree with Megatron-style
+    tensor-parallel kernel shardings (works on arrays or avals).
+
+    Leaves keep their leading gossip dimension; transformer projection
+    kernels additionally shard over ``tp_axis`` (column- or row-parallel by
+    module name); everything else (embeddings, LayerNorms, scalars,
+    momentum of the same leaves — matched by path) replicates over tp.
+    The manual gossip collective never sees the tp axis: it stays an Auto
+    axis that GSPMD parallelizes inside each rank.
+    """
+    from jax.sharding import NamedSharding
+
+    def spec_for(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", str(p)))
+                 for p in path]
+        ndim = jnp.ndim(leaf)
+        tail = [None] * (ndim - 1)
+        if ndim >= 3 and names and names[-1] == "kernel":
+            parent = names[-2]
+            if parent in _TP_COLUMN:
+                tail[-1] = tp_axis
+            elif parent in _TP_ROW:
+                tail[-2] = tp_axis
+        return NamedSharding(mesh, P(gossip_axis, *tail))
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def apply_tp_sharding(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
+                      tp_axis: str = TP_AXIS):
+    """Place an existing tree on a (gossip, tp) mesh
+    (see :func:`tp_sharding_tree`); prefer :func:`init_lm_state_tp` for
+    fresh state, which never materializes unsharded buffers."""
+    shardings = tp_sharding_tree(tree, mesh, gossip_axis, tp_axis)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+def init_lm_state_tp(model, mesh, algorithm, tx, dp: int, batch_size: int,
+                     seq_len: int, seed: int = 0) -> TrainState:
+    """Initialize TP-sharded LM state directly into its target shardings.
+
+    The whole state (params, momentum, gossip buffers) is built inside one
+    jitted program whose out_shardings carry the Megatron layout, so no
+    full unsharded replica ever materializes on a single device — the init
+    path scales to models that only fit *because* of tensor parallelism.
+    """
+    from .step import replicate_state
+
+    def build():
+        variables = model.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((batch_size, seq_len), jnp.int32))
+        params = replicate_state(variables["params"], dp)
+        one = lambda t: jax.tree.map(lambda a: a[0], t)
+        return TrainState(
+            step=jnp.zeros((dp,), jnp.int32), params=params,
+            batch_stats={},
+            opt_state=replicate_state(tx.init(one(params)), dp),
+            gossip=replicate_state(algorithm.init(one(params)), dp))
+
+    shapes = jax.eval_shape(build)
+    shardings = tp_sharding_tree(shapes, mesh)
+    return jax.jit(build, out_shardings=shardings)()
 
 
 def lm_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
@@ -100,9 +187,16 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
 
 def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
-                        seq_axis: str | None = SEQ_AXIS):
-    """Wrap for the 2-D mesh: state stacks over gossip ranks; token batches
-    stack over ``(gossip, seq)``."""
+                        seq_axis: str | None = SEQ_AXIS,
+                        tp: bool = False):
+    """Wrap for the mesh: state stacks over gossip ranks; token batches
+    stack over ``(gossip[, seq])``.
+
+    With ``tp=True`` the mesh's ``tp`` axis stays *auto*: the gossip
+    collective is manual SPMD while GSPMD partitions each rank's compute
+    over tp according to the arrays' own shardings
+    (see :func:`apply_tp_sharding`).
+    """
     if seq_axis is None:
         batch_spec = P(gossip_axis)
         squeeze_n = 1
@@ -118,10 +212,14 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
         return (jax.tree.map(lambda a: a[None], new_state),
                 jax.tree.map(lambda a: a[None], metrics))
 
+    kwargs = {}
+    if tp:
+        manual = {gossip_axis} | ({seq_axis} if seq_axis else set())
+        kwargs["axis_names"] = manual
     sharded = jax.shard_map(
         wrapped, mesh=mesh,
         in_specs=(P(gossip_axis), batch_spec, batch_spec),
-        out_specs=(P(gossip_axis), P(gossip_axis)))
+        out_specs=(P(gossip_axis), P(gossip_axis)), **kwargs)
     return jax.jit(sharded, donate_argnums=(0,))
 
 
