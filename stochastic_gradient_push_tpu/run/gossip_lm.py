@@ -3,9 +3,10 @@ ring-attention sequence parallelism.
 
 The reference's transformer experiments lived in an external fairseq fork
 (its repo ships only the log parser, visualization/plotting.py:137-192);
-here the transformer path is a first-class CLI.  The mesh is
-``(gossip, seq)``: gossip data parallelism over ``--world_size // --sp``
-replicas composed with ``--sp``-way exact ring attention.
+here the transformer path is a first-class CLI.  The mesh composes up to
+three axes — ``(gossip, seq, tp)``: gossip data parallelism over
+``world_size // (sp·tp)`` replicas, ``--sp``-way exact ring attention, and
+``--tp``-way Megatron tensor parallelism (GSPMD auto axis).
 
 Example (virtual 8-device CPU mesh, 4 replicas × 2 sequence shards):
 
@@ -68,7 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel shards per replica")
     p.add_argument("--tp", default=1, type=int,
                    help="tensor-parallel shards per replica (Megatron "
-                        "kernel sharding via GSPMD; incompatible with --sp)")
+                        "kernel sharding via GSPMD; composes with --sp "
+                        "on a 3-D gossip x seq x tp mesh)")
     p.add_argument("--batch_size", default=8, type=int,
                    help="sequences per replica per step")
     p.add_argument("--num_steps", default=1000, type=int)
@@ -97,10 +99,9 @@ def main(argv=None):
     from ..parallel import GOSSIP_AXIS
     from ..topology import build_schedule
     from ..train import LRSchedule, sgd
-    from ..train.lm import (SEQ_AXIS, apply_tp_sharding,
-                            build_lm_train_step, init_lm_state,
-                            make_dp_sp_mesh, make_dp_tp_mesh,
-                            shard_lm_train_step)
+    from ..train.lm import (SEQ_AXIS, build_lm_train_step, init_lm_state,
+                            make_dp_sp_mesh, make_dp_sp_tp_mesh,
+                            make_dp_tp_mesh, shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
     from ..utils import Meter, make_logger
     from .gossip_sgd import _str_bool as sb
@@ -111,16 +112,18 @@ def main(argv=None):
     sp, tp = args.sp, args.tp
     if sp < 1 or tp < 1:
         raise SystemExit("--sp and --tp must be >= 1")
-    if sp > 1 and tp > 1:
-        raise SystemExit("--sp and --tp cannot be combined yet")
     if world % (sp * tp):
         raise SystemExit(
             f"world_size {world} not divisible by sp*tp {sp * tp}")
     dp = world // (sp * tp)
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
-    mesh = (make_dp_tp_mesh(dp, tp) if tp > 1
-            else make_dp_sp_mesh(dp, sp))
+    if sp > 1 and tp > 1:
+        mesh = make_dp_sp_tp_mesh(dp, sp, tp)
+    elif tp > 1:
+        mesh = make_dp_tp_mesh(dp, tp)
+    else:
+        mesh = make_dp_sp_mesh(dp, sp)
 
     attn = args.attn
     if attn is None:
@@ -128,8 +131,9 @@ def main(argv=None):
             "flash" if jax.default_backend() == "tpu" else "full")
     if sp > 1 and attn != "ring":
         raise SystemExit("--sp > 1 requires ring attention")
-    if tp > 1 and attn == "ring":
-        raise SystemExit("--tp cannot be combined with ring attention")
+    if tp > 1 and sp == 1 and attn == "ring":
+        raise SystemExit(
+            "--tp with ring attention requires --sp > 1 (3-D mesh)")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -179,7 +183,7 @@ def main(argv=None):
         tp=tp > 1)
 
     ring = attn == "ring"
-    if tp > 1:
+    if tp > 1 and not ring:
         from ..train.lm import init_lm_state_tp
 
         state = init_lm_state_tp(model, mesh, alg, tx, dp=dp,

@@ -37,29 +37,36 @@ SEQ_AXIS = "seq"
 TP_AXIS = "tp"
 
 __all__ = ["SEQ_AXIS", "TP_AXIS", "make_dp_sp_mesh", "make_dp_tp_mesh",
-           "build_lm_train_step", "shard_lm_train_step", "lm_loss",
-           "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
-           "init_lm_state_tp"]
+           "make_dp_sp_tp_mesh", "build_lm_train_step",
+           "shard_lm_train_step", "lm_loss", "init_lm_state",
+           "apply_tp_sharding", "tp_sharding_tree", "init_lm_state_tp"]
 
 
-def _make_2d_mesh(dp: int, n: int, second_axis: str, devices) -> Mesh:
+def _make_mesh(dims: tuple, axes: tuple, devices) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    if len(devices) < dp * n:
-        raise ValueError(f"need {dp * n} devices, have {len(devices)}")
-    grid = np.asarray(devices[:dp * n]).reshape(dp, n)
-    return Mesh(grid, (GOSSIP_AXIS, second_axis))
+    n = int(np.prod(dims))
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]).reshape(dims), axes)
 
 
 def make_dp_sp_mesh(dp: int, sp: int, devices=None) -> Mesh:
     """2-D ``(gossip, seq)`` mesh: dp model replicas × sp sequence shards."""
-    return _make_2d_mesh(dp, sp, SEQ_AXIS, devices)
+    return _make_mesh((dp, sp), (GOSSIP_AXIS, SEQ_AXIS), devices)
 
 
 def make_dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
     """2-D ``(gossip, tp)`` mesh: dp gossip replicas × tp-way tensor
     parallelism inside each replica."""
-    return _make_2d_mesh(dp, tp, TP_AXIS, devices)
+    return _make_mesh((dp, tp), (GOSSIP_AXIS, TP_AXIS), devices)
+
+
+def make_dp_sp_tp_mesh(dp: int, sp: int, tp: int, devices=None) -> Mesh:
+    """3-D ``(gossip, seq, tp)`` mesh: gossip data parallelism × ring
+    sequence parallelism × GSPMD tensor parallelism, all composed."""
+    return _make_mesh((dp, sp, tp), (GOSSIP_AXIS, SEQ_AXIS, TP_AXIS),
+                      devices)
 
 
 # transformer modules whose kernels shard over the tp axis: column-parallel
@@ -214,6 +221,7 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
 
     kwargs = {}
     if tp:
+        # the tp mesh axis stays auto: GSPMD partitions per-rank compute
         manual = {gossip_axis} | ({seq_axis} if seq_axis else set())
         kwargs["axis_names"] = manual
     sharded = jax.shard_map(
@@ -243,14 +251,30 @@ def init_lm_state(model, mesh, algorithm, tx, dp: int, sp: int,
         variables = model.init(jax.random.PRNGKey(seed), t)
         return jax.tree.map(lambda a: a[None], variables["params"])
 
-    init_sharded = jax.jit(jax.shard_map(
-        init_fn, mesh=mesh, in_specs=(batch_spec,),
-        out_specs=P(gossip_axis)))
+    has_tp = TP_AXIS in mesh.axis_names
+    kwargs = {}
+    if has_tp:
+        kwargs["axis_names"] = {gossip_axis} | (
+            {seq_axis} if ring else set())
+    sm_init = jax.shard_map(init_fn, mesh=mesh, in_specs=(batch_spec,),
+                            out_specs=P(gossip_axis), **kwargs)
     dummy_shape = ((dp, sp, batch_size, block_len) if ring
                    else (dp, batch_size, block_len))
-    params = init_sharded(np.zeros(dummy_shape, np.int32))
-    one = lambda t: jax.tree.map(lambda a: a[0], t)
-    return TrainState(
-        step=jnp.zeros((dp,), jnp.int32), params=params, batch_stats={},
-        opt_state=replicate_state(tx.init(one(params)), dp),
-        gossip=replicate_state(algorithm.init(one(params)), dp))
+
+    def build(dummy):
+        params = sm_init(dummy)
+        one = lambda t: jax.tree.map(lambda a: a[0], t)
+        return TrainState(
+            step=jnp.zeros((dp,), jnp.int32), params=params,
+            batch_stats={},
+            opt_state=replicate_state(tx.init(one(params)), dp),
+            gossip=replicate_state(algorithm.init(one(params)), dp))
+
+    dummy = np.zeros(dummy_shape, np.int32)
+    if has_tp:
+        # materialize straight into the tensor-parallel layout: momentum
+        # and gossip buffers are created sharded, never full-size
+        shapes = jax.eval_shape(build, dummy)
+        return jax.jit(build, out_shardings=tp_sharding_tree(
+            shapes, mesh))(dummy)
+    return jax.jit(build)(dummy)
