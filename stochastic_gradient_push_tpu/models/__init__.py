@@ -9,6 +9,7 @@ from .resnet import (
     resnet101,
     resnet152,
 )
+from .moe import moe_capacity, switch_moe_ffn
 from .small import TinyCNN, TinyMLP
 from .transformer import TransformerConfig, TransformerLM
 
@@ -24,4 +25,6 @@ __all__ = [
     "TinyMLP",
     "TransformerLM",
     "TransformerConfig",
+    "switch_moe_ffn",
+    "moe_capacity",
 ]

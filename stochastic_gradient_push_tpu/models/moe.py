@@ -1,0 +1,110 @@
+"""Mixture-of-experts FFN with expert parallelism over a mesh axis.
+
+Completes the parallelism alphabet (dp × sp × tp × **ep**): experts shard
+over a manual ``ep`` mesh axis, tokens route top-1 (switch style) with a
+capacity limit, and two ``lax.all_to_all`` collectives move token slots to
+their experts' shards and back.  Each shard computes only its local experts
+over only the tokens routed to them — the compute- and memory-efficient
+formulation, not a masked dense mixture.
+
+Functional layer (explicit weights) so it slots into the same
+shard_map-based step structure as everything else:
+
+    y, aux = switch_moe_ffn(x, router_w, w1, w2, ep_axis="ep")
+
+``w1``/``w2`` carry the *local* expert slices (global ``[E, ...]`` arrays
+sharded over ``ep`` via ``in_specs=P("ep")``).  With ``ep_axis=None`` the
+same code runs single-shard with all experts — the numerical reference the
+tests pin the sharded version against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["switch_moe_ffn", "moe_capacity"]
+
+
+def moe_capacity(num_tokens: int, num_experts: int,
+                 capacity_factor: float = 1.25) -> int:
+    """Per-expert token slots per source shard."""
+    return max(1, int(num_tokens * capacity_factor / num_experts))
+
+
+def switch_moe_ffn(x, router_w, w1, w2, ep_axis: str | None = None,
+                   capacity_factor: float = 1.25):
+    """Top-1 switch MoE feed-forward.
+
+    Args:
+      x: ``[T, D]`` tokens (this shard's tokens when ``ep_axis`` is set).
+      router_w: ``[D, E]`` router weights (replicated; E = total experts).
+      w1: ``[E_local, D, F]`` up-projections (local expert slice).
+      w2: ``[E_local, F, D]`` down-projections.
+      ep_axis: mesh axis experts are sharded over (None = single shard).
+      capacity_factor: slots per expert = T·cf/E per source shard; tokens
+        over capacity receive zero expert output — callers supply the
+        residual connection that makes them pass through (standard switch
+        usage).
+
+    Returns ``(y [T, D], aux)`` where aux carries the load-balancing loss
+    (Switch Transformer's fraction·probability dot product) and the
+    fraction of dropped tokens.
+    """
+    t, d = x.shape
+    e_local = w1.shape[0]
+    ep = lax.axis_size(ep_axis) if ep_axis is not None else 1
+    e_total = e_local * ep
+    if router_w.shape[-1] != e_total:
+        raise ValueError(
+            f"router is over {router_w.shape[-1]} experts but weights "
+            f"provide {e_total} ({e_local} × {ep} shards)")
+    cap = moe_capacity(t, e_total, capacity_factor)
+
+    logits = x @ router_w                                    # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
+    top_prob = jnp.take_along_axis(
+        probs, expert_idx[:, None], axis=-1)[:, 0]           # [T]
+
+    onehot = jax.nn.one_hot(expert_idx, e_total,
+                            dtype=jnp.float32)               # [T, E]
+    # position of each token within its chosen expert's queue
+    cum = jnp.cumsum(onehot.astype(jnp.int32), axis=0)       # [T, E]
+    pos = jnp.take_along_axis(
+        cum, expert_idx[:, None], axis=-1)[:, 0] - 1         # [T] int32
+    kept = pos < cap
+    # out-of-capacity tokens index slot == cap → one_hot gives all-zeros
+    slot = jax.nn.one_hot(jnp.where(kept, pos, cap), cap,
+                          dtype=jnp.float32)                 # [T, C]
+    dispatch = onehot[:, :, None] * slot[:, None, :]         # [T, E, C]
+
+    x_slots = jnp.einsum("tec,td->ecd", dispatch,
+                         x.astype(jnp.float32))              # [E, C, D]
+
+    if ep_axis is not None:
+        # [E, C, D] → this shard's experts with every shard's slots:
+        # [E_local, ep·C, D]
+        x_slots = lax.all_to_all(x_slots, ep_axis, split_axis=0,
+                                 concat_axis=1, tiled=True)
+
+    h = jnp.einsum("ecd,edf->ecf", x_slots, w1.astype(jnp.float32))
+    h = jax.nn.gelu(h)
+    y_slots = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
+
+    if ep_axis is not None:
+        y_slots = lax.all_to_all(y_slots, ep_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)  # [E, C, D]
+
+    combine = dispatch * top_prob[:, None, None]             # [T, E, C]
+    y = jnp.einsum("tec,ecd->td", combine, y_slots)
+
+    # Switch load-balancing loss: E · Σ_e (token fraction)·(mean prob)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = {
+        "load_balance_loss": e_total * jnp.sum(frac * mean_prob),
+        "dropped_fraction": 1.0 - jnp.mean(kept.astype(jnp.float32)),
+    }
+    return y.astype(x.dtype), aux

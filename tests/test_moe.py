@@ -1,0 +1,110 @@
+"""Expert parallelism: sharded switch MoE == single-shard reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.models.moe import (
+    moe_capacity,
+    switch_moe_ffn,
+)
+
+EP = 4
+T, D, F, E = 32, 8, 16, 8  # tokens per shard, dims, total experts
+E_LOCAL = E // EP
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    return Mesh(_np.array(jax.devices()[:EP]), ("ep",))
+
+
+@pytest.fixture(scope="module")
+def weights():
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(D, E)).astype(np.float32) * 0.5,
+            rng.normal(size=(E, D, F)).astype(np.float32) * 0.3,
+            rng.normal(size=(E, F, D)).astype(np.float32) * 0.3)
+
+
+def test_ep_sharded_matches_single_shard(mesh, weights):
+    router_w, w1, w2 = weights
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(EP, T, D)).astype(np.float32)
+
+    def sharded(xs, w1s, w2s):
+        y, aux = switch_moe_ffn(xs[0], router_w, w1s, w2s, ep_axis="ep")
+        return y[None], jax.tree.map(lambda a: a[None], aux)
+
+    f = jax.jit(jax.shard_map(
+        sharded, mesh=mesh, in_specs=(P("ep"), P("ep"), P("ep")),
+        out_specs=(P("ep"), P("ep"))))
+    y_ep, aux_ep = f(x, w1, w2)
+    y_ep = np.asarray(y_ep)
+    # guard against vacuous equivalence: outputs must be nontrivial
+    assert np.abs(y_ep).max() > 0.01, "MoE produced (near-)zero outputs"
+
+    # single-shard reference processes each shard's tokens with all experts
+    for shard in range(EP):
+        y_ref, aux_ref = switch_moe_ffn(
+            jnp.asarray(x[shard]), router_w, jnp.asarray(w1),
+            jnp.asarray(w2), ep_axis=None)
+        np.testing.assert_allclose(y_ep[shard], np.asarray(y_ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_moe_routing_and_capacity():
+    router_w, w1, w2 = (np.zeros((D, E), np.float32),
+                        np.ones((E, D, F), np.float32),
+                        np.ones((E, F, D), np.float32))
+    # force all tokens to expert 0 via a biased router
+    router_w[:, 0] = 0
+    router_w[0, 0] = 100.0
+    x = np.ones((T, D), np.float32)
+    y, aux = switch_moe_ffn(jnp.asarray(x), jnp.asarray(router_w),
+                            jnp.asarray(w1), jnp.asarray(w2), ep_axis=None)
+    cap = moe_capacity(T, E)
+    # only `cap` tokens fit in expert 0; the rest are dropped
+    np.testing.assert_allclose(float(aux["dropped_fraction"]),
+                               (T - cap) / T, atol=1e-6)
+    # dropped tokens contribute zero output
+    nonzero_rows = np.abs(np.asarray(y)).sum(axis=-1) > 0
+    assert nonzero_rows.sum() == cap
+
+
+def test_moe_gradients_flow(mesh, weights):
+    router_w, w1, w2 = weights
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(EP, T, D)).astype(np.float32)
+
+    def loss_fn(xs, rw, w1s, w2s):
+        y, aux = switch_moe_ffn(xs[0], rw, w1s, w2s, ep_axis="ep")
+        return (jnp.sum(y ** 2)
+                + 0.01 * aux["load_balance_loss"])[None]
+
+    def step(xs, rw, w1s, w2s):
+        g = jax.grad(lambda *a: loss_fn(*a).sum(),
+                     argnums=(1, 2, 3))(xs, rw, w1s, w2s)
+        return g
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=(P(), P("ep"), P("ep"))))
+    g_rw, g_w1, g_w2 = f(x, router_w, w1, w2)
+    for g in (g_rw, g_w1, g_w2):
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g))
+        assert np.abs(g).max() > 0
+
+
+def test_moe_router_size_validation():
+    with pytest.raises(ValueError, match="router"):
+        switch_moe_ffn(jnp.ones((4, D)), jnp.ones((D, 4)),
+                       jnp.ones((E, D, F)), jnp.ones((E, F, D)),
+                       ep_axis=None)
