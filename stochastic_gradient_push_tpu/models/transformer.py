@@ -14,6 +14,9 @@ first-class in-repo model family, built TPU-first:
   holding ``seq/world`` tokens
 * pointwise sublayers (embedding, LN, MLP, logits) act per-token, so under
   sequence sharding they need no communication at all
+* optional switch-MoE feed-forward blocks with experts sharded over an
+  ``ep`` mesh axis (models/moe.py): set ``moe_experts > 0`` and every
+  ``moe_every``-th block routes tokens to experts via all_to_all
 """
 
 from __future__ import annotations
@@ -57,6 +60,10 @@ class TransformerConfig(tp.NamedTuple):
     attn_block_size: int = 128        # for blockwise
     seq_axis: str | None = None       # mesh axis for ring attention
     remat: bool = False               # jax.checkpoint each block
+    moe_experts: int = 0              # total experts (0 = dense FFN)
+    moe_every: int = 2                # every k-th block uses MoE
+    ep_axis: str | None = None        # mesh axis experts shard over
+    moe_capacity_factor: float = 1.25
 
 
 class _Attention(nn.Module):
@@ -112,8 +119,48 @@ class _Attention(nn.Module):
                         name="o")(out)
 
 
+class _MoEFFN(nn.Module):
+    """Switch-MoE feed-forward (models/moe.py) as a flax module.
+
+    Expert weights carry the *local* slice when ``ep_axis`` is set — the
+    state layout shards the expert dimension over ``ep`` (see
+    ``train/lm.py::ep_state_specs``); the router is replicated.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from .moe import switch_moe_ffn
+
+        cfg = self.cfg
+        ep = 1
+        if cfg.ep_axis is not None:
+            ep = lax.axis_size(cfg.ep_axis)
+        if cfg.moe_experts % ep:
+            raise ValueError(
+                f"moe_experts {cfg.moe_experts} not divisible by ep {ep}")
+        e_local = cfg.moe_experts // ep
+        router = self.param(
+            "router", nn.initializers.normal(0.02),
+            (cfg.d_model, cfg.moe_experts), jnp.float32)
+        w1 = self.param("experts_up", nn.initializers.lecun_normal(),
+                        (e_local, cfg.d_model, cfg.d_ff), jnp.float32)
+        w2 = self.param("experts_down", nn.initializers.lecun_normal(),
+                        (e_local, cfg.d_ff, cfg.d_model), jnp.float32)
+
+        b, t, d = x.shape
+        flat = x.reshape(b * t, d)
+        y, aux = switch_moe_ffn(
+            flat, router, w1, w2, ep_axis=cfg.ep_axis,
+            capacity_factor=cfg.moe_capacity_factor)
+        self.sow("losses", "load_balance", aux["load_balance_loss"])
+        return y.reshape(b, t, d)
+
+
 class _Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -121,6 +168,10 @@ class _Block(nn.Module):
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
         x = x + _Attention(cfg, name="attn")(ln("ln1")(x), positions)
         h = ln("ln2")(x)
+        if self.use_moe:
+            # dropped (over-capacity) tokens contribute zero here and ride
+            # the residual connection through unchanged
+            return x + _MoEFFN(cfg, name="moe")(h)
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="up")(h)
         h = nn.gelu(h)
         h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(h)
@@ -141,6 +192,8 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, train: bool = True):
         del train  # no dropout in the base recipe
         cfg = self.cfg
+        if cfg.moe_experts > 0 and cfg.moe_every < 1:
+            raise ValueError("moe_every must be >= 1 when moe_experts > 0")
         b, t = tokens.shape
         if cfg.attn_impl == "ring":
             offset = lax.axis_index(cfg.seq_axis) * t
@@ -155,7 +208,9 @@ class TransformerLM(nn.Module):
         if cfg.remat:
             block = nn.remat(_Block)
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"block_{i}")(x, positions)
+            use_moe = (cfg.moe_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=cfg.dtype, name="lm_head")(x)

@@ -35,11 +35,13 @@ from .state import TrainState
 
 SEQ_AXIS = "seq"
 TP_AXIS = "tp"
+EP_AXIS = "ep"
 
-__all__ = ["SEQ_AXIS", "TP_AXIS", "make_dp_sp_mesh", "make_dp_tp_mesh",
-           "make_dp_sp_tp_mesh", "build_lm_train_step",
-           "shard_lm_train_step", "lm_loss", "init_lm_state",
-           "apply_tp_sharding", "tp_sharding_tree", "init_lm_state_tp"]
+__all__ = ["SEQ_AXIS", "TP_AXIS", "EP_AXIS", "make_dp_sp_mesh",
+           "make_dp_tp_mesh", "make_dp_sp_tp_mesh", "make_dp_ep_mesh",
+           "build_lm_train_step", "shard_lm_train_step", "lm_loss",
+           "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
+           "init_lm_state_tp", "ep_state_specs", "init_lm_state_ep"]
 
 
 def _make_mesh(dims: tuple, axes: tuple, devices) -> Mesh:
@@ -67,6 +69,34 @@ def make_dp_sp_tp_mesh(dp: int, sp: int, tp: int, devices=None) -> Mesh:
     sequence parallelism × GSPMD tensor parallelism, all composed."""
     return _make_mesh((dp, sp, tp), (GOSSIP_AXIS, SEQ_AXIS, TP_AXIS),
                       devices)
+
+
+def make_dp_ep_mesh(dp: int, ep: int, devices=None) -> Mesh:
+    """2-D ``(gossip, ep)`` mesh: gossip replicas × expert parallelism.
+
+    The ep axis doubles as extra data parallelism for the non-MoE
+    sublayers: each ep shard carries its own tokens, and replicated-
+    parameter gradients are exactly averaged over ep (like the
+    hierarchical local axis) while expert slices stay shard-local.
+    """
+    return _make_mesh((dp, ep), (GOSSIP_AXIS, EP_AXIS), devices)
+
+
+def _is_expert_path(path) -> bool:
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    return any(n in ("experts_up", "experts_down") for n in names)
+
+
+def ep_state_specs(state, gossip_axis: str = GOSSIP_AXIS,
+                   ep_axis: str = EP_AXIS):
+    """Per-leaf PartitionSpecs for an expert-parallel LM state: expert
+    weight leaves shard ``(gossip, ep)`` on their leading dims, everything
+    else replicates over ep with ``P(gossip)``.  Works on arrays/avals."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (P(gossip_axis, ep_axis)
+                            if _is_expert_path(path)
+                            else P(gossip_axis)),
+        state)
 
 
 # transformer modules whose kernels shard over the tp axis: column-parallel
@@ -152,12 +182,17 @@ def lm_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
 
 def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                         itr_per_epoch: int,
-                        seq_axis: str | None = SEQ_AXIS) -> tp.Callable:
+                        seq_axis: str | None = SEQ_AXIS,
+                        ep_axis: str | None = None,
+                        moe_loss_coef: float = 0.01) -> tp.Callable:
     """Per-rank LM step ``(state, tokens, targets) -> (state, metrics)``.
 
     Same four-slot structure as the image step (train/step.py); loss is
     token-mean cross-entropy, and with sequence sharding the seq-psummed
-    gradients are renormalized to the global token mean.
+    gradients are renormalized to the global token mean.  With
+    ``ep_axis``, MoE load-balance losses (sown by the model) join the
+    objective, replicated-parameter gradients are renormalized over the
+    ep shards, and expert-slice gradients stay shard-local.
     """
 
     def train_step(state: TrainState, tokens, targets):
@@ -165,10 +200,18 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         z = algorithm.eval_params(params, gstate)
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, tokens, train=True)
-            return lm_loss(logits, targets)
+            logits, mutated = model.apply(
+                {"params": p}, tokens, train=True, mutable=["losses"])
+            ce = lm_loss(logits, targets)
+            loss = ce
+            sown = jax.tree.leaves(mutated.get("losses", {}))
+            if sown:
+                loss = loss + moe_loss_coef * sum(
+                    jnp.mean(l) for l in sown) / len(sown)
+            return loss, ce
 
-        loss, grads = jax.value_and_grad(loss_fn)(z)
+        (loss, ce), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(z)
 
         if seq_axis is not None:
             # params are invariant over seq → autodiff psums grads over the
@@ -176,6 +219,17 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             n_seq = lax.axis_size(seq_axis)
             grads = jax.tree.map(lambda g: g / n_seq, grads)
             loss = lax.pmean(loss, seq_axis)
+            ce = lax.pmean(ce, seq_axis)
+        if ep_axis is not None:
+            # replicated params are invariant over ep → autodiff psums
+            # their grads across the ep shards' different tokens; divide
+            # for the mean.  Expert slices vary over ep: grads are local.
+            n_ep = lax.axis_size(ep_axis)
+            grads = jax.tree_util.tree_map_with_path(
+                lambda path, g: g if _is_expert_path(path) else g / n_ep,
+                grads)
+            loss = lax.pmean(loss, ep_axis)
+            ce = lax.pmean(ce, ep_axis)
         grads = algorithm.reduce_grads(grads)
 
         step = as_scalar(state.step)
@@ -186,7 +240,9 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             lambda p, u: p - lr.astype(p.dtype) * u, params, updates)
         params, gstate = algorithm.post_step(params, gstate)
 
-        metrics = {"loss": loss, "ppl": jnp.exp(loss), "lr": lr}
+        # perplexity from the bare cross-entropy, not the MoE-augmented
+        # objective
+        metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr}
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 
@@ -195,7 +251,9 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
 def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
                         seq_axis: str | None = SEQ_AXIS,
-                        tp: bool = False):
+                        tp: bool = False,
+                        state_specs=None,
+                        ep_axis: str | None = None):
     """Wrap for the mesh: state stacks over gossip ranks; token batches
     stack over ``(gossip[, seq])``.
 
@@ -224,10 +282,15 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
         # the tp mesh axis stays auto: GSPMD partitions per-rank compute
         manual = {gossip_axis} | ({seq_axis} if seq_axis else set())
         kwargs["axis_names"] = manual
+    state_spec = P(gossip_axis) if state_specs is None else state_specs
+    if ep_axis is not None:
+        # with expert parallelism, token batches shard over (gossip, ep)
+        batch_spec = P(gossip_axis, ep_axis)
+        squeeze_n = 2
     sharded = jax.shard_map(
         wrapped, mesh=mesh,
-        in_specs=(P(gossip_axis), batch_spec, batch_spec),
-        out_specs=(P(gossip_axis), P(gossip_axis)), **kwargs)
+        in_specs=(state_spec, batch_spec, batch_spec),
+        out_specs=(state_spec, P(gossip_axis)), **kwargs)
     return jax.jit(sharded, donate_argnums=(0,))
 
 
@@ -278,3 +341,62 @@ def init_lm_state(model, mesh, algorithm, tx, dp: int, sp: int,
         return jax.jit(build, out_shardings=tp_sharding_tree(
             shapes, mesh))(dummy)
     return jax.jit(build)(dummy)
+
+
+def init_lm_state_ep(model, mesh, algorithm, tx, dp: int, ep: int,
+                     batch_size: int, seq_len: int,
+                     seed: int = 0) -> TrainState:
+    """Initialize expert-parallel LM state on a ``(gossip, ep)`` mesh;
+    pair with ``ep_state_specs(state)`` for the train step's specs.
+
+    Parameter init runs under shard_map (the MoE module sizes its local
+    expert slice from the live ep axis); replicated leaves are made
+    ep-invariant with a no-op ``pmean`` (identical values on every shard),
+    expert leaves exit sharded over ep, and the whole state materializes
+    straight into its per-leaf shardings.
+    """
+    from jax.sharding import NamedSharding
+
+    from .step import replicate_state
+
+    def init_fn(toks):
+        # two init draws: a common key for replicated leaves (identical on
+        # every shard → pmean is a no-op that proves ep-invariance) and a
+        # shard-folded key so every GLOBAL expert gets an independent draw
+        common = model.init(jax.random.PRNGKey(seed), toks[0, 0])["params"]
+        local = model.init(
+            jax.random.fold_in(jax.random.PRNGKey(seed),
+                               lax.axis_index(EP_AXIS)),
+            toks[0, 0])["params"]
+        params = jax.tree_util.tree_map_with_path(
+            lambda path, c, l: l if _is_expert_path(path)
+            else lax.pmean(c, EP_AXIS),
+            common, local)
+        return jax.tree.map(lambda a: a[None], params)
+
+    # param STRUCTURE (paths only) via an axis-free probe of the same cfg
+    probe = type(model)(model.cfg._replace(ep_axis=None))
+    probe_shapes = jax.eval_shape(
+        lambda: probe.init(jax.random.PRNGKey(seed),
+                           jnp.zeros((batch_size, seq_len), jnp.int32)))
+    param_specs = ep_state_specs(probe_shapes["params"])
+
+    sm_init = jax.shard_map(
+        init_fn, mesh=mesh, in_specs=(P(GOSSIP_AXIS, EP_AXIS),),
+        out_specs=param_specs)
+    dummy = np.zeros((dp, ep, batch_size, seq_len), np.int32)
+
+    def build(d):
+        params = sm_init(d)
+        one = lambda t: jax.tree.map(lambda a: a[0], t)
+        return TrainState(
+            step=jnp.zeros((dp,), jnp.int32), params=params,
+            batch_stats={},
+            opt_state=replicate_state(tx.init(one(params)), dp),
+            gossip=replicate_state(algorithm.init(one(params)), dp))
+
+    shapes = jax.eval_shape(build, dummy)
+    specs = ep_state_specs(shapes)
+    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(build, out_shardings=shardings)(dummy)
