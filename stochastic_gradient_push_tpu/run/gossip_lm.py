@@ -71,6 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel shards per replica (Megatron "
                         "kernel sharding via GSPMD; composes with --sp "
                         "on a 3-D gossip x seq x tp mesh)")
+    p.add_argument("--ep", default=1, type=int,
+                   help="expert-parallel shards (requires --moe_experts; "
+                        "each ep shard also carries its own tokens)")
+    p.add_argument("--moe_experts", default=0, type=int,
+                   help="total switch-MoE experts (0 = dense FFN)")
+    p.add_argument("--moe_every", default=2, type=int)
     p.add_argument("--batch_size", default=8, type=int,
                    help="sequences per replica per step")
     p.add_argument("--num_steps", default=1000, type=int)
@@ -99,7 +105,9 @@ def main(argv=None):
     from ..parallel import GOSSIP_AXIS
     from ..topology import build_schedule
     from ..train import LRSchedule, sgd
-    from ..train.lm import (SEQ_AXIS, build_lm_train_step, init_lm_state,
+    from ..train.lm import (EP_AXIS, SEQ_AXIS, build_lm_train_step,
+                            ep_state_specs, init_lm_state,
+                            init_lm_state_ep, make_dp_ep_mesh,
                             make_dp_sp_mesh, make_dp_sp_tp_mesh,
                             make_dp_tp_mesh, shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
@@ -109,16 +117,26 @@ def main(argv=None):
     log = make_logger("lm", True)
 
     world = args.world_size or jax.device_count()
-    sp, tp = args.sp, args.tp
-    if sp < 1 or tp < 1:
-        raise SystemExit("--sp and --tp must be >= 1")
-    if world % (sp * tp):
+    sp, tp, ep = args.sp, args.tp, args.ep
+    if sp < 1 or tp < 1 or ep < 1:
+        raise SystemExit("--sp, --tp and --ep must be >= 1")
+    if ep > 1 and (sp > 1 or tp > 1):
+        raise SystemExit("--ep composes with gossip DP only (no --sp/--tp)")
+    if ep > 1 and not args.moe_experts:
+        raise SystemExit("--ep requires --moe_experts > 0")
+    if args.moe_experts and args.moe_experts % ep:
         raise SystemExit(
-            f"world_size {world} not divisible by sp*tp {sp * tp}")
-    dp = world // (sp * tp)
+            f"moe_experts {args.moe_experts} not divisible by ep {ep}")
+    if world % (sp * tp * ep):
+        raise SystemExit(
+            f"world_size {world} not divisible by sp*tp*ep "
+            f"{sp * tp * ep}")
+    dp = world // (sp * tp * ep)
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
-    if sp > 1 and tp > 1:
+    if ep > 1:
+        mesh = make_dp_ep_mesh(dp, ep)
+    elif sp > 1 and tp > 1:
         mesh = make_dp_sp_tp_mesh(dp, sp, tp)
     elif tp > 1:
         mesh = make_dp_tp_mesh(dp, tp)
@@ -134,6 +152,8 @@ def main(argv=None):
     if tp > 1 and sp == 1 and attn == "ring":
         raise SystemExit(
             "--tp with ring attention requires --sp > 1 (3-D mesh)")
+    if ep > 1 and attn == "ring":
+        raise SystemExit("--ep cannot be combined with ring attention")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -141,7 +161,9 @@ def main(argv=None):
         max_len=args.seq_len,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         attn_impl=attn, seq_axis=SEQ_AXIS if attn == "ring" else None,
-        remat=sb(args.remat))
+        remat=sb(args.remat),
+        moe_experts=args.moe_experts, moe_every=args.moe_every,
+        ep_axis=EP_AXIS if ep > 1 else None)
     model = TransformerLM(cfg)
 
     if sb(args.all_reduce):
@@ -172,29 +194,40 @@ def main(argv=None):
     # itr_per_epoch below).
     warmup_steps = args.warmup_steps or max(args.num_steps // 10, 1)
     itr_per_epoch = max(warmup_steps // WARMUP_EPOCHS, 1)
+    # LR scaling counts every shard that contributes tokens to the global
+    # batch: gossip replicas and ep shards do, seq/tp shards don't
     lrs = LRSchedule(ref_lr=args.lr, batch_size=args.batch_size,
-                     world_size=dp, decay_schedule={},
+                     world_size=dp * ep, decay_schedule={},
                      warmup=sb(args.warmup))
     step = build_lm_train_step(
         model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
-        seq_axis=SEQ_AXIS if attn == "ring" else None)
-    train_fn = shard_lm_train_step(
-        step, mesh, seq_axis=SEQ_AXIS if attn == "ring" else None,
-        tp=tp > 1)
+        seq_axis=SEQ_AXIS if attn == "ring" else None,
+        ep_axis=EP_AXIS if ep > 1 else None)
 
     ring = attn == "ring"
-    if tp > 1 and not ring:
+    if ep > 1:
+        state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
+                                 batch_size=args.batch_size,
+                                 seq_len=args.seq_len, seed=args.seed)
+        train_fn = shard_lm_train_step(
+            step, mesh, seq_axis=None,
+            state_specs=ep_state_specs(state), ep_axis=EP_AXIS)
+    elif tp > 1 and not ring:
         from ..train.lm import init_lm_state_tp
 
         state = init_lm_state_tp(model, mesh, alg, tx, dp=dp,
                                  batch_size=args.batch_size,
                                  seq_len=args.seq_len, seed=args.seed)
+        train_fn = shard_lm_train_step(step, mesh, seq_axis=None,
+                                       tp=True)
     else:
         state = init_lm_state(
             model, mesh, alg, tx, dp=dp, sp=sp,
             batch_size=args.batch_size,
             block_len=args.seq_len // sp if ring else args.seq_len,
             seed=args.seed, seq_axis=SEQ_AXIS if ring else None)
+        train_fn = shard_lm_train_step(
+            step, mesh, seq_axis=SEQ_AXIS if ring else None, tp=tp > 1)
 
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree.leaves(
@@ -213,16 +246,21 @@ def main(argv=None):
     steps_done = 0
     epoch = 0
     t0 = time.time()
-    tokens_per_step = dp * args.batch_size * args.seq_len
+    tokens_per_step = dp * ep * args.batch_size * args.seq_len
     # XLA CPU in-process collectives require serialized dispatch; on TPU we
     # fetch metrics only at print points so dispatch stays asynchronous
     serialize = jax.default_backend() == "cpu"
     metrics = None
     while steps_done < args.num_steps:
-        for tokens, targets in lm_batches(corpus, dp, sp, args.batch_size,
-                                          args.seq_len,
+        for tokens, targets in lm_batches(corpus, dp * ep, sp,
+                                          args.batch_size, args.seq_len,
                                           seed=args.seed + epoch):
-            if attn != "ring":
+            if ep > 1:
+                tokens = tokens.reshape(dp, ep, args.batch_size,
+                                        args.seq_len)
+                targets = targets.reshape(dp, ep, args.batch_size,
+                                          args.seq_len)
+            elif attn != "ring":
                 tokens = tokens.reshape(dp, args.batch_size, args.seq_len)
                 targets = targets.reshape(dp, args.batch_size, args.seq_len)
             state, metrics = train_fn(state, tokens, targets)
