@@ -155,6 +155,8 @@ class _MoEFFN(nn.Module):
             flat, router, w1, w2, ep_axis=cfg.ep_axis,
             capacity_factor=cfg.moe_capacity_factor)
         self.sow("losses", "load_balance", aux["load_balance_loss"])
+        self.sow("moe_metrics", "dropped_fraction",
+                 aux["dropped_fraction"])
         return y.reshape(b, t, d)
 
 

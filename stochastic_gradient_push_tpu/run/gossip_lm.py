@@ -122,6 +122,10 @@ def main(argv=None):
         raise SystemExit("--sp, --tp and --ep must be >= 1")
     if ep > 1 and (sp > 1 or tp > 1):
         raise SystemExit("--ep composes with gossip DP only (no --sp/--tp)")
+    if args.moe_experts and tp > 1:
+        raise SystemExit(
+            "--moe_experts with --tp is unsupported: expert weights are "
+            "not tensor-parallel sharded yet (see ROADMAP.md)")
     if ep > 1 and not args.moe_experts:
         raise SystemExit("--ep requires --moe_experts > 0")
     if args.moe_experts and args.moe_experts % ep:

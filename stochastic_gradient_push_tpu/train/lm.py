@@ -201,16 +201,20 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
         def loss_fn(p):
             logits, mutated = model.apply(
-                {"params": p}, tokens, train=True, mutable=["losses"])
+                {"params": p}, tokens, train=True,
+                mutable=["losses", "moe_metrics"])
             ce = lm_loss(logits, targets)
             loss = ce
             sown = jax.tree.leaves(mutated.get("losses", {}))
             if sown:
                 loss = loss + moe_loss_coef * sum(
                     jnp.mean(l) for l in sown) / len(sown)
-            return loss, ce
+            dropped = jax.tree.leaves(mutated.get("moe_metrics", {}))
+            dropped = (sum(jnp.mean(d) for d in dropped) / len(dropped)
+                       if dropped else jnp.float32(0.0))
+            return loss, (ce, dropped)
 
-        (loss, ce), grads = jax.value_and_grad(
+        (loss, (ce, dropped)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(z)
 
         if seq_axis is not None:
@@ -230,6 +234,7 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                 grads)
             loss = lax.pmean(loss, ep_axis)
             ce = lax.pmean(ce, ep_axis)
+            dropped = lax.pmean(dropped, ep_axis)
         grads = algorithm.reduce_grads(grads)
 
         step = as_scalar(state.step)
@@ -241,8 +246,9 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         params, gstate = algorithm.post_step(params, gstate)
 
         # perplexity from the bare cross-entropy, not the MoE-augmented
-        # objective
-        metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr}
+        # objective; moe_dropped makes capacity overflow observable
+        metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr,
+                   "moe_dropped": dropped}
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 

@@ -190,3 +190,28 @@ def test_visualization_parses_trainer_csv(tmp_path):
     fig = plot_itrs(str(tmp_path), world_size=8, out_path=str(
         tmp_path / "fig.png"))
     assert (tmp_path / "fig.png").exists()
+
+
+def test_plot_scaling_and_transformer_parse(tmp_path):
+    from stochastic_gradient_push_tpu.visualization import (
+        parse_transformer_out,
+        plot_scaling,
+        plot_transformer,
+    )
+
+    fig = plot_scaling({4: 0.4, 8: 0.45, 16: 0.5},
+                       baseline={4: 0.5, 8: 0.7, 16: 1.1},
+                       out_path=str(tmp_path / "scaling.png"))
+    assert (tmp_path / "scaling.png").exists()
+
+    log = tmp_path / "transformer.log"
+    log.write_text(
+        "| epoch 001 | loss 7.123 | wall 120.5 |\n"
+        "garbage line\n"
+        "| epoch 002 | loss 6.050 | wall 260.0 |\n")
+    df = parse_transformer_out(str(log))
+    assert len(df) == 2
+    assert df["loss"].tolist() == [7.123, 6.05]
+    plot_transformer({"SGP": str(log)},
+                     out_path=str(tmp_path / "nll.png"))
+    assert (tmp_path / "nll.png").exists()
