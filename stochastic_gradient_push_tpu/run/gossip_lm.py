@@ -122,10 +122,10 @@ def main(argv=None):
         raise SystemExit("--sp, --tp and --ep must be >= 1")
     if ep > 1 and (sp > 1 or tp > 1):
         raise SystemExit("--ep composes with gossip DP only (no --sp/--tp)")
-    if args.moe_experts and tp > 1:
+    if args.moe_experts and sp > 1:
         raise SystemExit(
-            "--moe_experts with --tp is unsupported: expert weights are "
-            "not tensor-parallel sharded yet (see ROADMAP.md)")
+            "--moe_experts with ring sequence parallelism is unsupported "
+            "(per-block routing semantics untested; see ROADMAP.md)")
     if ep > 1 and not args.moe_experts:
         raise SystemExit("--ep requires --moe_experts > 0")
     if args.moe_experts and args.moe_experts % ep:
@@ -243,8 +243,10 @@ def main(argv=None):
     os.makedirs(args.checkpoint_dir, exist_ok=True)
     out_fname = os.path.join(args.checkpoint_dir,
                              f"{args.tag}out_n{world}.csv")
+    moe_on = args.moe_experts > 0
     with open(out_fname, "w") as f:
-        print("step,loss,ppl,lr,tokens_per_sec", file=f)
+        print("step,loss,ppl,lr,tokens_per_sec"
+              + (",moe_dropped" if moe_on else ""), file=f)
 
     loss_meter = Meter(ptag="Loss")
     steps_done = 0
@@ -275,11 +277,15 @@ def main(argv=None):
                 loss = float(np.mean(np.asarray(metrics["loss"])))
                 loss_meter.update(loss)
                 tps = tokens_per_step * steps_done / (time.time() - t0)
+                row = (f"{steps_done},{loss:.4f},"
+                       f"{float(np.mean(np.asarray(metrics['ppl']))):.2f},"
+                       f"{float(np.mean(np.asarray(metrics['lr']))):.5f},"
+                       f"{tps:.0f}")
+                if moe_on:
+                    row += (",%.4f" % float(
+                        np.mean(np.asarray(metrics['moe_dropped']))))
                 with open(out_fname, "a") as f:
-                    print(f"{steps_done},{loss:.4f},"
-                          f"{float(np.mean(np.asarray(metrics['ppl']))):.2f},"
-                          f"{float(np.mean(np.asarray(metrics['lr']))):.5f},"
-                          f"{tps:.0f}", file=f)
+                    print(row, file=f)
             if steps_done >= args.num_steps:
                 break
         epoch += 1

@@ -101,9 +101,12 @@ def ep_state_specs(state, gossip_axis: str = GOSSIP_AXIS,
 
 # transformer modules whose kernels shard over the tp axis: column-parallel
 # (output features split) then row-parallel (input features split), the
-# Megatron pattern — GSPMD inserts the reduction after o/down projections
+# Megatron pattern — GSPMD inserts the reduction after o/down projections.
+# MoE expert stacks follow the same pattern on their trailing dims.
 _TP_COLUMN = {"q", "k", "v", "up", "lm_head"}
 _TP_ROW = {"o", "down"}
+_TP_EXPERT_COLUMN = {"experts_up"}      # [E, D, F]: shard F
+_TP_EXPERT_ROW = {"experts_down"}       # [E, F, D]: shard F
 
 
 def tp_sharding_tree(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
@@ -130,6 +133,11 @@ def tp_sharding_tree(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
             if parent in _TP_COLUMN:
                 tail[-1] = tp_axis
             elif parent in _TP_ROW:
+                tail[-2] = tp_axis
+        elif ndim >= 4 and names:
+            if names[-1] in _TP_EXPERT_COLUMN:
+                tail[-1] = tp_axis
+            elif names[-1] in _TP_EXPERT_ROW:
                 tail[-2] = tp_axis
         return NamedSharding(mesh, P(gossip_axis, *tail))
 
@@ -224,6 +232,7 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             grads = jax.tree.map(lambda g: g / n_seq, grads)
             loss = lax.pmean(loss, seq_axis)
             ce = lax.pmean(ce, seq_axis)
+            dropped = lax.pmean(dropped, seq_axis)
         if ep_axis is not None:
             # replicated params are invariant over ep → autodiff psums
             # their grads across the ep shards' different tokens; divide
