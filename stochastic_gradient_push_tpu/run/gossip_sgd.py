@@ -120,6 +120,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler device trace into this "
                         "directory (TensorBoard format); bounded by "
                         "--profile_epochs")
+    p.add_argument("--per_rank_csv", default="False", type=str,
+                   help="emit one CSV per gossip rank (reference parity) "
+                        "instead of a single rank-averaged file")
     p.add_argument("--profile_epochs", default=1, type=int,
                    help="trace only the first N epochs of the run "
                         "(a full-run trace is unloadable for real jobs)")
@@ -189,6 +192,7 @@ def parse_config(argv=None):
         label_smoothing=args.label_smoothing,
         grad_accum=args.grad_accum,
         gossip_comm_dtype=args.gossip_comm_dtype,
+        per_rank_csv=_str_bool(args.per_rank_csv),
     )
     return cfg, args
 

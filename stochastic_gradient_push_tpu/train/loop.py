@@ -95,6 +95,9 @@ class TrainerConfig:
     scan_steps: int = 1
     # decode workers for streaming loaders (reported in the CSV preamble)
     num_dataloader_workers: int = 0
+    # emit one CSV per gossip rank with that rank's metrics (the
+    # reference's per-process files); off = one rank-averaged out_r0 file
+    per_rank_csv: bool = False
 
 
 class Trainer:
@@ -136,9 +139,11 @@ class Trainer:
         self._warm_counts: dict = {}
         self._eval_fn = None
 
-        self.out_fname = os.path.join(
+        self._csv_ranks = (range(self.gossip_world)
+                           if config.per_rank_csv else (0,))
+        self._fname = lambda r: os.path.join(
             config.checkpoint_dir,
-            f"{config.tag}out_r0_n{self.world_size}.csv")
+            f"{config.tag}out_r{r}_n{self.world_size}.csv")
 
     # -- algorithm / step construction ------------------------------------
 
@@ -205,8 +210,10 @@ class Trainer:
 
     def _init_csv(self) -> None:
         os.makedirs(self.cfg.checkpoint_dir, exist_ok=True)
-        if not os.path.exists(self.out_fname):
-            with open(self.out_fname, "w") as f:
+        for r in self._csv_ranks:
+            if os.path.exists(self._fname(r)):
+                continue
+            with open(self._fname(r), "w") as f:
                 print("BEGIN-TRAINING\n"
                       f"World-Size,{self.world_size}\n"
                       f"Num-DLWorkers,{self.cfg.num_dataloader_workers}\n"
@@ -217,19 +224,27 @@ class Trainer:
                       "Loss,avg:Loss,Prec@1,avg:Prec@1,Prec@5,avg:Prec@5,val",
                       file=f)
 
-    def _log_row(self, epoch, itr, meters, losses, top1, top5) -> None:
+    def _log_row(self, epoch, itr, meters, stat_meters) -> None:
+        """One training row per CSV; stat_meters[r] carries rank r's
+        (losses, top1, top5) Meters (timing is shared: one process
+        drives every rank)."""
         bt, nt, dt = meters
-        with open(self.out_fname, "a") as f:
-            print(f"{epoch},{itr},{bt},{nt},{dt},"
-                  f"{losses.val:.4f},{losses.avg:.4f},"
-                  f"{top1.val:.3f},{top1.avg:.3f},"
-                  f"{top5.val:.3f},{top5.avg:.3f},-1", file=f)
+        for r in self._csv_ranks:
+            losses, top1, top5 = stat_meters[r]
+            with open(self._fname(r), "a") as f:
+                print(f"{epoch},{itr},{bt},{nt},{dt},"
+                      f"{losses.val:.4f},{losses.avg:.4f},"
+                      f"{top1.val:.3f},{top1.avg:.3f},"
+                      f"{top5.val:.3f},{top5.avg:.3f},-1", file=f)
 
-    def _log_val_row(self, epoch, meters, val) -> None:
+    def _log_val_row(self, epoch, meters, vals) -> None:
+        """vals[r] is rank r's validation top-1 (all equal when only
+        the rank-averaged file is written)."""
         bt, nt, dt = meters
-        with open(self.out_fname, "a") as f:
-            print(f"{epoch},-1,{bt},{nt},{dt},-1,-1,-1,-1,-1,-1,{val}",
-                  file=f)
+        for r in self._csv_ranks:
+            with open(self._fname(r), "a") as f:
+                print(f"{epoch},-1,{bt},{nt},{dt},-1,-1,-1,-1,-1,-1,"
+                      f"{vals[r]}", file=f)
 
     # -- main entry points -------------------------------------------------
 
@@ -310,7 +325,10 @@ class Trainer:
                 prec1 = (self.validate(state, alg, val_loader)
                          if val_loader is not None else -1.0)
                 final_prec1 = prec1
-                self._log_val_row(epoch, meters, prec1)
+                vals = (self._last_val_per_rank if cfg.per_rank_csv
+                        and val_loader is not None
+                        else {r: prec1 for r in self._csv_ranks})
+                self._log_val_row(epoch, meters, vals)
                 is_best = prec1 > best_prec1
                 best_prec1 = max(best_prec1, prec1)
                 if self.cluster is not None:
@@ -343,9 +361,9 @@ class Trainer:
                      start_itr, meters):
         cfg = self.cfg
         batch_meter, nn_meter, data_meter = meters
-        losses = Meter(ptag="Loss")
-        top1 = Meter(ptag="Prec@1")
-        top5 = Meter(ptag="Prec@5")
+        stat_meters = {r: (Meter(ptag="Loss"), Meter(ptag="Prec@1"),
+                           Meter(ptag="Prec@5"))
+                       for r in self._csv_ranks}
         num_itr_ignore = cfg.num_itr_ignore
         cap = cfg.num_iterations_per_training_epoch
         cap = None if cap in (None, -1) else cap
@@ -369,12 +387,16 @@ class Trainer:
                 else:
                     num_itr_ignore -= 1
                 n = metric_slices["n"]
-                losses.update(metric_slices["loss"][j], n)
-                top1.update(metric_slices["top1"][j], n)
-                top5.update(metric_slices["top5"][j], n)
+                for r in self._csv_ranks:
+                    losses, top1, top5 = stat_meters[r]
+                    pick = (lambda a: a[r, j]) if cfg.per_rank_csv \
+                        else (lambda a: a[:, j].mean())
+                    losses.update(float(pick(metric_slices["loss"])), n)
+                    top1.update(float(pick(metric_slices["top1"])), n)
+                    top5.update(float(pick(metric_slices["top5"])), n)
                 itr = i + j
                 if itr % cfg.print_freq == 0:
-                    self._log_row(epoch, itr, meters, losses, top1, top5)
+                    self._log_row(epoch, itr, meters, stat_meters)
 
         it = iter(loader)
         i = start_itr - 1
@@ -424,10 +446,10 @@ class Trainer:
                 self._warm_counts.get(warm_key, 0) + 1
             state, metrics = train_fn(state, x, y)
             jax.block_until_ready(state)
-            # metrics: [world] for a single step, [world, chunk] scanned —
-            # normalize to per-iteration arrays averaged over ranks
-            to_arr = lambda m: np.atleast_1d(
-                np.mean(np.asarray(m), axis=0)).reshape(chunk)
+            # metrics: [world] for a single step, [world, chunk] when
+            # scanned — normalize to [world, chunk]
+            to_arr = lambda m: np.asarray(m).reshape(
+                self.gossip_world, chunk)
             slices = {
                 "n": pending[0][0].shape[0] * pending[0][0].shape[1],
                 "loss": to_arr(metrics["loss"]),
@@ -441,7 +463,7 @@ class Trainer:
             i += chunk
             batch_time = time.time()
 
-        self._log_row(epoch, i, meters, losses, top1, top5)
+        self._log_row(epoch, i, meters, stat_meters)
         return state
 
     def validate(self, state, algorithm, val_loader) -> float:
@@ -455,6 +477,7 @@ class Trainer:
         losses = Meter(ptag="Loss")
         top1 = Meter(ptag="Prec@1")
         top5 = Meter(ptag="Prec@5")
+        rank_top1 = np.zeros(self.gossip_world)
         n_batches = 0
         for x, y in val_loader:
             m = self._eval_fn(state, x, y)
@@ -462,12 +485,15 @@ class Trainer:
             losses.update(float(np.mean(m["loss"])), n)
             top1.update(float(np.mean(m["top1"])), n)
             top5.update(float(np.mean(m["top5"])), n)
+            rank_top1 += np.asarray(m["top1"]).reshape(self.gossip_world)
             n_batches += 1
         if n_batches == 0:
             self.log.warning(
                 "validation loader yielded no batches (dataset smaller "
                 "than one world batch?) — reporting -1")
+            self._last_val_per_rank = [-1.0] * self.gossip_world
             return -1.0
+        self._last_val_per_rank = (rank_top1 / n_batches).tolist()
         self.log.info(
             f" * Prec@1 {top1.avg:.3f} Prec@5 {top5.avg:.3f}")
         return top1.avg
