@@ -1,65 +1,112 @@
 """Headline benchmark: ResNet-50 SGP train-step throughput on TPU.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 The reference's headline benchmark family is ResNet-50/ImageNet
 time-per-iteration and derived images/sec (BASELINE.md; reference
-visualization/plotting.py:315-345).  The repo publishes no absolute numbers
-(SURVEY.md §6), so the baseline constant below is the per-worker throughput
-implied by the paper's hardware class: a V100 running the reference recipe
-(fp32, per-GPU batch 32, NCCL/gossip overhead included) sustains roughly
-300 images/sec/worker.  ``vs_baseline`` = our images/sec per chip / 300.
+visualization/plotting.py:315-345).
 
-This runs the *full* SGP train step (forward, backward, torch-semantics SGD,
-push-sum gossip round, metrics) — on a single chip the gossip collective
-degenerates to identity but stays in the program, so the compiled step is
-structurally identical to the multi-chip one.
+Hardened against a flaky accelerator tunnel (round-1 failure mode: the
+backend init either hung or raised UNAVAILABLE, and the round's perf
+artifact was a stack trace): the measurement runs in a *subprocess* with a
+hard timeout, retried several times, and if the TPU never comes up the
+parent emits a parseable JSON line from a CPU fallback run instead of a
+traceback.  Extra diagnostics beyond the headline number:
+
+* ``mfu``       — model FLOP utilization, from XLA's compiled cost
+                  analysis over the device's peak bf16 FLOP/s.
+* ``fwd_ms``    — forward-only latency (inference step), so perf loss can
+                  be localized between forward, backward+opt, and gossip.
+* ``step_ms``   — full train-step latency (fwd, bwd, torch-semantics SGD,
+                  push-sum gossip round, metrics).
+
+This measures the *full* SGP train step — on a single chip the gossip
+collective degenerates to identity but stays in the program, so the
+compiled step is structurally identical to the multi-chip one.
+
+Env knobs: BENCH_BATCH, BENCH_IMAGE, BENCH_WARMUP, BENCH_STEPS,
+BENCH_SCAN (steps fused per dispatch), BENCH_ATTEMPTS, BENCH_TIMEOUT
+(per-attempt seconds), BENCH_DEADLINE (overall seconds), BENCH_PHASES=0
+to skip the forward-only breakdown, BENCH_PEAK_TFLOPS to override the
+peak-FLOPs table.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-# honor a user-forced platform but default to the real TPU
-import jax
-import jax.numpy as jnp
-import numpy as np
+REFERENCE_IMAGES_PER_SEC_PER_WORKER = 300.0  # see BASELINE.md
 
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-from stochastic_gradient_push_tpu.algorithms import sgp
-from stochastic_gradient_push_tpu.data import synthetic_classification
-from stochastic_gradient_push_tpu.models import resnet50
-from stochastic_gradient_push_tpu.parallel import GOSSIP_AXIS, make_gossip_mesh
-from stochastic_gradient_push_tpu.topology import (
-    NPeerDynamicDirectedExponentialGraph,
-    RingGraph,
-    build_schedule,
+# peak dense bf16 TFLOP/s per chip, by device_kind substring (public specs)
+PEAK_BF16_TFLOPS = (
+    ("v6 lite", 918.0),   # Trillium / v6e
+    ("v6e", 918.0),
+    ("v5 lite", 197.0),   # v5e
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
 )
-from stochastic_gradient_push_tpu.train import (
-    LRSchedule,
-    build_train_step,
-    init_train_state,
-    replicate_state,
-    sgd,
-    shard_scanned_train_step,
-    shard_train_step,
-)
-
-REFERENCE_IMAGES_PER_SEC_PER_WORKER = 300.0  # see module docstring
 
 BATCH = int(os.environ.get("BENCH_BATCH", "64"))
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
-WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
-STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-# fuse this many steps into one compiled program (1 = per-step dispatch)
+# at least one warmup call (compile) and one timed step, whatever the env says
+WARMUP = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
+STEPS = max(1, int(os.environ.get("BENCH_STEPS", "20")))
 SCAN = int(os.environ.get("BENCH_SCAN", "5"))
 
 
-def main():
+def peak_tflops(device_kind: str) -> float | None:
+    override = os.environ.get("BENCH_PEAK_TFLOPS")
+    if override:
+        return float(override)
+    kind = device_kind.lower()
+    for sub, tf in PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return tf
+    return None
+
+
+def _flops_of(compiled) -> float | None:
+    """Total-program FLOPs from XLA's cost analysis, if exposed."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = ca.get("flops")
+        return float(f) if f and f > 0 else None
+    except Exception:
+        return None
+
+
+def run_measurement() -> dict:
+    """The actual benchmark (runs inside the child subprocess)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from stochastic_gradient_push_tpu.algorithms import sgp
+    from stochastic_gradient_push_tpu.data import synthetic_classification
+    from stochastic_gradient_push_tpu.models import resnet50
+    from stochastic_gradient_push_tpu.parallel import (
+        GOSSIP_AXIS, make_gossip_mesh)
+    from stochastic_gradient_push_tpu.topology import (
+        NPeerDynamicDirectedExponentialGraph, RingGraph, build_schedule)
+    from stochastic_gradient_push_tpu.train import (
+        LRSchedule, build_train_step, init_train_state, replicate_state,
+        sgd, shard_scanned_train_step, shard_train_step)
+
     world = jax.device_count()
+    platform = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
     mesh = make_gossip_mesh(world)
 
     model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
@@ -93,37 +140,168 @@ def main():
         x = np.broadcast_to(x[None], (SCAN,) + x.shape).copy()
         y = np.broadcast_to(y[None], (SCAN,) + y.shape).copy()
 
+    # pin the batch on device once: the benchmark measures the train step,
+    # not host->device transfer (which on a tunneled dev box dominates —
+    # ~190MB/call turned round 1's first probe into a bandwidth test)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, GOSSIP_AXIS) if SCAN > 1 else P(GOSSIP_AXIS)
+    x = jax.device_put(x, NamedSharding(mesh, spec))
+    y = jax.device_put(y, NamedSharding(mesh, spec))
+
+    # FLOPs for MFU: compile ahead-of-time so the cost analysis and the
+    # timed executions share one executable (no double compile)
+    flops_per_program = None
+    try:
+        compiled = train_fn.lower(state, x, y).compile()
+        flops_per_program = _flops_of(compiled)
+        run = compiled
+    except Exception:
+        run = train_fn  # fall back to the normal jit path
+
     # XLA CPU in-process collectives deadlock with concurrent executions;
     # serialize dispatch there (TPU keeps fully async dispatch)
-    serialize = jax.default_backend() == "cpu"
+    serialize = platform == "cpu"
+
+    def fence(state, metrics):
+        """Completion fence: a host readback of a value that depends on the
+        whole step.  ``block_until_ready`` alone is not trusted — on a
+        tunneled dev box it can return at RPC-ack time, which made an early
+        probe report a 410% MFU (the measurement was dispatch latency)."""
+        jax.block_until_ready(state)
+        return float(np.min(np.asarray(jax.device_get(metrics["loss"]))))
 
     for _ in range(WARMUP):
-        state, metrics = train_fn(state, x, y)
+        state, metrics = run(state, x, y)
         if serialize:
             jax.block_until_ready(state)
-    jax.block_until_ready(state)
+    fence(state, metrics)
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        state, metrics = train_fn(state, x, y)
+        state, metrics = run(state, x, y)
         if serialize:
             jax.block_until_ready(state)
-    jax.block_until_ready(state)
+    loss = fence(state, metrics)
     dt = time.perf_counter() - t0
+    if not np.isfinite(loss):
+        raise RuntimeError(f"non-finite loss {loss} — benchmark invalid")
 
     time_per_itr = dt / (STEPS * SCAN)
     images_per_sec = world * BATCH / time_per_itr
     per_chip = images_per_sec / world
 
-    print(json.dumps({
+    out = {
         "metric": "resnet50_sgp_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "scan": SCAN,
+        "batch": BATCH,
+        "platform": platform,
+        "device": device_kind,
+        "step_ms": round(time_per_itr * 1e3, 3),
         "vs_baseline": round(
             per_chip / REFERENCE_IMAGES_PER_SEC_PER_WORKER, 3),
-    }))
+    }
+
+    peak = peak_tflops(device_kind)
+    if flops_per_program and peak:
+        # XLA's cost analysis counts a lax.scan body ONCE regardless of
+        # trip count (verified empirically), so the scanned program's flops
+        # already equal one iteration's flops — no division by SCAN
+        flops_per_itr = flops_per_program
+        mfu = (flops_per_itr / time_per_itr) / (peak * 1e12 * world)
+        out["mfu"] = round(mfu, 4)
+        out["tflops_per_itr"] = round(flops_per_itr / 1e12, 3)
+
+    if os.environ.get("BENCH_PHASES", "1") == "1":
+        # forward-only latency on de-biased params: localizes perf between
+        # forward, backward+opt, and gossip
+        def fwd(state, x):
+            z = alg.eval_params(
+                jax.tree.map(lambda a: a[0], state.params),
+                jax.tree.map(lambda a: a[0], state.gossip))
+            bstats = jax.tree.map(lambda a: a[0], state.batch_stats)
+            return model.apply({"params": z, "batch_stats": bstats},
+                               x[0] if SCAN == 1 else x[0, 0],
+                               train=False)
+
+        fwd_j = jax.jit(fwd)
+        _ = np.asarray(jax.device_get(fwd_j(state, x)))[0, 0]
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            r = fwd_j(state, x)
+        _ = np.asarray(jax.device_get(r))[0, 0]  # completion fence
+        out["fwd_ms"] = round((time.perf_counter() - t0) / STEPS * 1e3, 3)
+
+    return out
+
+
+def _attempt(env: dict, timeout: float) -> tuple[dict | None, str]:
+    """Run one child measurement; return (JSON dict or None, error tail)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip()
+        return None, f"rc={proc.returncode}: ...{tail[-300:]}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    return None, "child produced no JSON line"
+
+
+def main():
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    per_attempt = float(os.environ.get("BENCH_TIMEOUT", "900"))
+    deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
+    start = time.monotonic()
+
+    errors = []
+    for i in range(attempts):
+        remaining = deadline - (time.monotonic() - start)
+        if remaining <= 60:
+            errors.append(f"attempt {i}: skipped (deadline)")
+            break
+        result, err = _attempt(dict(os.environ),
+                               timeout=min(per_attempt, remaining))
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"attempt {i}: {err}")
+        if i < attempts - 1:
+            time.sleep(min(30.0, max(
+                0.0, deadline - (time.monotonic() - start))))
+
+    # TPU never came up: emit a *parseable* CPU-fallback number with the
+    # failure recorded, never a traceback (round-1 VERDICT item 1)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_BATCH"] = env.get("BENCH_CPU_BATCH", "4")
+    env["BENCH_WARMUP"] = "1"
+    env["BENCH_STEPS"] = "3"
+    env["BENCH_SCAN"] = "1"
+    env["BENCH_PHASES"] = "0"
+    result, err = _attempt(env, timeout=600)
+    if result is None:
+        errors.append(f"cpu fallback: {err}")
+    if result is None:
+        result = {"metric": "resnet50_sgp_images_per_sec_per_chip",
+                  "value": None, "unit": "images/sec/chip",
+                  "vs_baseline": None}
+    result["error"] = "; ".join(errors) or "accelerator unavailable"
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        print(json.dumps(run_measurement()))
+    else:
+        main()

@@ -9,9 +9,11 @@ Mirrors the reference's data plumbing (gossip_sgd.py:539-583):
   per rank.
 * :class:`ShardedLoader` — batches every rank's shard and stacks them into
   one global ``(world, per_rank_batch, ...)`` array, the layout the sharded
-  train step consumes.  ``fast_forward`` reproduces the reference's
-  checkpoint-resume sampler spoofing (gossip_sgd.py:356-364) without
-  loading and discarding data.
+  train step consumes.  Under multi-host execution each process constructs
+  it with ``ranks=`` (its ``parallel.multihost.owned_ranks``) and gets only
+  its local rows, ready for ``jax.make_array_from_process_local_data``.
+  ``fast_forward`` reproduces the reference's checkpoint-resume sampler
+  spoofing (gossip_sgd.py:356-364) without loading and discarding data.
 * :func:`synthetic_classification` — a deterministic, learnable synthetic
   dataset (class-dependent means + noise) used by smoke tests and
   benchmarks; the reference has no equivalent (its only testing affordance
@@ -21,6 +23,8 @@ Mirrors the reference's data plumbing (gossip_sgd.py:539-583):
 """
 
 from __future__ import annotations
+
+import typing as tp
 
 import numpy as np
 
@@ -78,13 +82,15 @@ class ShardedLoader:
     """
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
-                 batch_size: int, sampler: DistributedSampler):
+                 batch_size: int, sampler: DistributedSampler,
+                 ranks: tp.Sequence[int] | None = None):
         if len(images) != len(labels):
             raise ValueError("images and labels length mismatch")
         self.images = images
         self.labels = labels
         self.batch_size = int(batch_size)
         self.sampler = sampler
+        self.ranks = None if ranks is None else list(ranks)
         self.start_itr = 0
 
     def __len__(self) -> int:
@@ -97,6 +103,8 @@ class ShardedLoader:
 
     def __iter__(self):
         table = self.sampler.all_indices()
+        if self.ranks is not None:
+            table = table[self.ranks]
         n_batches = len(self)
         for b in range(self.start_itr, n_batches):
             sel = table[:, b * self.batch_size:(b + 1) * self.batch_size]

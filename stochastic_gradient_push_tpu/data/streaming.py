@@ -32,7 +32,8 @@ class StreamingImageFolder:
 
     def __init__(self, root: str, split: str, world_size: int,
                  batch_size: int, image_size: int = 224, train: bool = True,
-                 num_workers: int = 8, prefetch: int = 4, seed: int = 0):
+                 num_workers: int = 8, prefetch: int = 4, seed: int = 0,
+                 ranks: tp.Sequence[int] | None = None):
         self.dataset = ImageFolderDataset(
             f"{root}/{split}" if split else root,
             image_size=image_size, train=train, seed=seed)
@@ -41,6 +42,8 @@ class StreamingImageFolder:
         self.num_workers = max(num_workers, 1)
         self.prefetch = max(prefetch, 1)
         self.sampler = DistributedSampler(len(self.dataset), world_size)
+        # multi-host: decode only this process's rank rows
+        self.ranks = None if ranks is None else list(ranks)
         self.start_itr = 0
 
     @property
@@ -59,18 +62,21 @@ class StreamingImageFolder:
 
     def _load_batch(self, idx_block: np.ndarray
                     ) -> tuple[np.ndarray, np.ndarray]:
-        """Decode one global batch: idx_block is (world, batch) indices."""
+        """Decode one batch block: idx_block is (rows, batch) indices."""
         flat = idx_block.reshape(-1)
         images = np.stack([self.dataset[i][0] for i in flat])
         labels = np.asarray([self.dataset.labels[i] for i in flat],
                             np.int32)
         s = self.dataset.image_size
-        return (images.reshape(self.world_size, self.batch_size, s, s, 3),
-                labels.reshape(self.world_size, self.batch_size))
+        rows = idx_block.shape[0]
+        return (images.reshape(rows, self.batch_size, s, s, 3),
+                labels.reshape(rows, self.batch_size))
 
     def __iter__(self) -> tp.Iterator[tuple[np.ndarray, np.ndarray]]:
         n_batches = len(self)
         table = self.sampler.all_indices()  # (world, num_samples)
+        if self.ranks is not None:
+            table = table[self.ranks]
         start = self.start_itr
         self.start_itr = 0
         blocks = [table[:, b * self.batch_size:(b + 1) * self.batch_size]

@@ -9,6 +9,13 @@ from .mesh import (
 )
 from .averaging import consensus_error, push_sum_average
 from .discovery import ClusterInfo, discover, initialize_multihost
+from .multihost import (
+    global_state_from_local,
+    host_local_slice,
+    make_global_batch,
+    owned_ranks,
+    to_host,
+)
 from .ring_attention import blockwise_attention, ring_attention
 from .collectives import (
     allreduce_mean,
@@ -28,6 +35,11 @@ __all__ = [
     "ClusterInfo",
     "discover",
     "initialize_multihost",
+    "owned_ranks",
+    "make_global_batch",
+    "to_host",
+    "host_local_slice",
+    "global_state_from_local",
     "gossip_round",
     "mix_push_sum",
     "mix_push_pull",

@@ -1,0 +1,136 @@
+"""Multi-host execution helpers: per-process feeding and host-local state.
+
+The reference runs one process per GPU and wires them with
+``dist.init_process_group`` (gossip_sgd.py:586-690); every tensor a process
+touches is local.  Under JAX's multi-controller SPMD model each process
+owns a *slice* of every global array instead, so three conversions are
+needed around the compiled step:
+
+* host feed  → :func:`make_global_batch`
+  (``jax.make_array_from_process_local_data`` over the mesh): each process
+  contributes the batch rows for the gossip ranks whose devices it holds.
+* host read  ← :func:`to_host`: metrics come back sharded across hosts;
+  a tiny jitted identity with replicated output sharding all-gathers them
+  so every process sees the full per-rank metric vector.
+* checkpoint ← :func:`host_local_slice`: each process saves/restores only
+  its addressable ranks (the reference's per-rank checkpoint files,
+  cluster_manager.py:62-78, become per-process files).
+
+Rank ownership (:func:`owned_ranks`) follows the mesh: gossip rank ``i``
+belongs to the process holding the device at mesh position ``i`` along the
+gossip axis.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing as tp
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["owned_ranks", "make_global_batch", "to_host",
+           "host_local_slice", "global_state_from_local",
+           "process_count", "process_index",
+           "HIERARCHICAL_IS_SINGLE_PROCESS"]
+
+# single source of truth for the guard raised at both the CLI and the
+# Trainer boundary
+HIERARCHICAL_IS_SINGLE_PROCESS = (
+    "hierarchical (nprocs_per_node) meshes are single-process for now; "
+    "use the flat gossip mesh on pods")
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def owned_ranks(mesh: Mesh, axis: str) -> list[int]:
+    """Gossip ranks whose devices belong to this process.
+
+    For a 1-D gossip mesh each device is one rank; for a hierarchical
+    ``(node, local)`` mesh the rank is the index along ``axis`` and a rank
+    is owned iff its *first* device is local (ranks never straddle
+    processes on TPU pods: a node's devices share a host).
+    """
+    axis_index = mesh.axis_names.index(axis)
+    devs = mesh.devices
+    # move the rank axis to the front, flatten the rest
+    devs = np.moveaxis(devs, axis_index, 0).reshape(devs.shape[axis_index], -1)
+    me = jax.process_index()
+    return [int(i) for i in range(devs.shape[0])
+            if devs[i, 0].process_index == me]
+
+
+def make_global_batch(mesh: Mesh, spec: P, local_batch: np.ndarray):
+    """Assemble a global device array from this process's batch rows.
+
+    ``local_batch`` carries only the rows for :func:`owned_ranks` (in rank
+    order) along the sharded dimension; single-process meshes pass the full
+    array through unchanged.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return local_batch
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+@functools.lru_cache(maxsize=4)
+def _replicator(mesh: Mesh):
+    """Jitted identity with fully-replicated output sharding — the
+    all-gather that turns sharded metrics into host-readable numpy.
+    Bounded cache (meshes are hashable); one compiled fn per mesh."""
+    return jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))
+
+
+def to_host(tree, mesh: Mesh):
+    """Full (host-replicated) numpy values of a mesh-sharded pytree."""
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+    return jax.tree.map(np.asarray, _replicator(mesh)(tree))
+
+
+def host_local_slice(tree) -> tp.Any:
+    """This process's rows of a world-stacked sharded pytree, as numpy.
+
+    Leaves have a leading rank dimension sharded over the gossip axis;
+    each process's addressable shards are its owned ranks.  Shards are
+    concatenated in global-index order, so the result lines up with
+    :func:`owned_ranks`.
+    """
+
+    def one(leaf):
+        if not isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        if jax.process_count() == 1:
+            return np.asarray(leaf)
+        shards = sorted(leaf.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        blocks, seen = [], set()
+        for s in shards:
+            start = s.index[0].start or 0
+            if start in seen:      # replicas of the same rank (local axis)
+                continue
+            seen.add(start)
+            blocks.append(np.asarray(s.data))
+        return np.concatenate(blocks, axis=0)
+
+    return jax.tree.map(one, tree)
+
+
+def global_state_from_local(mesh: Mesh, axis: str, local_tree):
+    """Inverse of :func:`host_local_slice`: build the global world-stacked
+    state from this process's rank rows (leading dimension)."""
+    spec = P(axis)
+    if jax.process_count() == 1:
+        return local_tree
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda leaf: jax.make_array_from_process_local_data(
+            sharding, np.asarray(leaf)), local_tree)

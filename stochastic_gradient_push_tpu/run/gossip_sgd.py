@@ -123,6 +123,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--per_rank_csv", default="False", type=str,
                    help="emit one CSV per gossip rank (reference parity) "
                         "instead of a single rank-averaged file")
+    p.add_argument("--multihost", default="auto",
+                   choices=["auto", "True", "False"],
+                   help="join a multi-host cluster via "
+                        "jax.distributed.initialize; 'auto' joins when "
+                        "SLURM/coordinator env vars are present "
+                        "(≙ dist.init_process_group, gossip_sgd.py:671-673)")
+    p.add_argument("--coordinator_address", default=None, type=str,
+                   help="host:port of process 0 (multi-host rendezvous)")
+    p.add_argument("--num_processes", default=None, type=int)
+    p.add_argument("--process_id", default=None, type=int)
+    p.add_argument("--heartbeat_timeout", default=300, type=int,
+                   help="seconds a blocking step may take before the "
+                        "watchdog logs a stall (0 disables; ≙ the gossip "
+                        "flag timeout, distributed.py:36)")
+    p.add_argument("--ckpt_backend", default="msgpack",
+                   choices=["msgpack", "orbax"],
+                   help="checkpoint serialization backend")
     p.add_argument("--profile_epochs", default=1, type=int,
                    help="trace only the first N epochs of the run "
                         "(a full-run trace is unloadable for real jobs)")
@@ -193,6 +210,7 @@ def parse_config(argv=None):
         grad_accum=args.grad_accum,
         gossip_comm_dtype=args.gossip_comm_dtype,
         per_rank_csv=_str_bool(args.per_rank_csv),
+        heartbeat_timeout=args.heartbeat_timeout,
     )
     return cfg, args
 
@@ -212,13 +230,22 @@ def main(argv=None, config_transform=None, extra_args=None):
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # multi-host rendezvous BEFORE any other jax use (≙ the reference's
+    # dist.init_process_group placement, gossip_sgd.py:671-673)
+    want_mh = getattr(args, "multihost", "auto")
+    if want_mh == "True" or (want_mh == "auto" and _multihost_env()):
+        from ..parallel.discovery import initialize_multihost
+
+        initialize_multihost(args.coordinator_address, args.num_processes,
+                             args.process_id)
+
     from ..data import (DistributedSampler, ShardedLoader,
                         StreamingImageFolder, synthetic_classification)
     from ..models import RESNETS, TinyCNN
     from ..parallel import make_gossip_mesh, make_hierarchical_mesh
     from ..train.loop import Trainer
     from ..utils import make_logger
-    from ..utils.checkpoint import CheckpointManager, ClusterManager
+    from ..utils.checkpoint import ClusterManager
 
     log = make_logger("main", cfg.verbose)
     world = args.world_size or jax.device_count()
@@ -228,6 +255,33 @@ def main(argv=None, config_transform=None, extra_args=None):
     else:
         mesh = make_gossip_mesh(world)
     log.info(f"mesh: {mesh}; devices: {world}")
+
+    proc_count = jax.process_count()
+    proc_index = jax.process_index()
+    if proc_count > 1:
+        if args.nprocs_per_node > 1:
+            from ..parallel.multihost import HIERARCHICAL_IS_SINGLE_PROCESS
+
+            raise SystemExit(HIERARCHICAL_IS_SINGLE_PROCESS)
+        if not cfg.checkpoint_all:
+            # every process holds *different* ranks; funnelling them into
+            # one rank-0 file would interleave writers and corrupt it
+            raise SystemExit(
+                "--checkpoint_all False is single-process only: on a pod "
+                "each process must write its own checkpoint file")
+        if getattr(args, "ckpt_backend", "msgpack") == "orbax":
+            raise SystemExit(
+                "--ckpt_backend orbax is single-process for now (orbax "
+                "treats numpy saves as replicated across processes); use "
+                "the msgpack backend on pods")
+        from ..parallel import GOSSIP_AXIS
+        from ..parallel.multihost import owned_ranks
+
+        local_ranks = owned_ranks(mesh, GOSSIP_AXIS)
+        log.info(f"process {proc_index}/{proc_count}: feeding ranks "
+                 f"{local_ranks}")
+    else:
+        local_ranks = None
 
     import jax.numpy as jnp
 
@@ -249,7 +303,8 @@ def main(argv=None, config_transform=None, extra_args=None):
         images, labels = all_images[:n], all_labels[:n]
         val_images, val_labels = all_images[n:], all_labels[n:]
         sampler = DistributedSampler(len(images), world)
-        loader = ShardedLoader(images, labels, cfg.batch_size, sampler)
+        loader = ShardedLoader(images, labels, cfg.batch_size, sampler,
+                               ranks=local_ranks)
     else:
         if not args.dataset_dir:
             raise SystemExit("--dataset_dir required for imagefolder")
@@ -259,21 +314,21 @@ def main(argv=None, config_transform=None, extra_args=None):
         loader = StreamingImageFolder(
             args.dataset_dir, "train", world, cfg.batch_size,
             image_size=args.image_size, train=True,
-            num_workers=workers, seed=cfg.seed)
+            num_workers=workers, seed=cfg.seed, ranks=local_ranks)
         sampler = loader  # owns set_epoch for both sampling and augment
         val_loader = StreamingImageFolder(
             args.dataset_dir, "val", world, cfg.batch_size,
-            image_size=args.image_size, train=False, num_workers=workers)
+            image_size=args.image_size, train=False, num_workers=workers,
+            ranks=local_ranks)
 
     if args.dataset == "synthetic":
         val_sampler = DistributedSampler(len(val_images), world)
         val_loader = ShardedLoader(val_images, val_labels, cfg.batch_size,
-                                   val_sampler)
+                                   val_sampler, ranks=local_ranks)
 
-    ckpt = CheckpointManager(cfg.checkpoint_dir, tag=cfg.tag,
-                             world_size=world,
-                             all_workers=cfg.checkpoint_all)
-    cluster = ClusterManager(ckpt, requeue_command=args.requeue_command or
+    ckpt = _make_ckpt_manager(args, cfg, world, proc_index)
+    cluster = ClusterManager(ckpt, rank=proc_index,
+                             requeue_command=args.requeue_command or
                              _default_requeue())
 
     channels = images.shape[-1] if args.dataset == "synthetic" else 3
@@ -298,9 +353,38 @@ def main(argv=None, config_transform=None, extra_args=None):
         with trace(args.profile_dir):
             state, _ = profile_trainer.fit(state, loader, sampler, None)
     state, result = trainer.fit(state, loader, sampler, val_loader)
+    if hasattr(ckpt, "wait"):
+        ckpt.wait()  # async backends: land in-flight saves before exit
     log.info(f"done: {result['best_prec1']:.3f} best top-1, "
              f"elapsed {result['elapsed_time']:.1f}s")
     return result
+
+
+def _make_ckpt_manager(args, cfg, world: int, proc_index: int):
+    """Select the checkpoint backend (--ckpt_backend): the self-contained
+    msgpack manager, or orbax (async saves + retention GC) for big jobs."""
+    if getattr(args, "ckpt_backend", "msgpack") == "orbax":
+        from ..utils.orbax_ckpt import OrbaxCheckpointManager
+
+        return OrbaxCheckpointManager(
+            cfg.checkpoint_dir, tag=cfg.tag, rank=proc_index,
+            world_size=world, all_workers=cfg.checkpoint_all)
+    from ..utils.checkpoint import CheckpointManager
+
+    return CheckpointManager(cfg.checkpoint_dir, tag=cfg.tag,
+                             rank=proc_index, world_size=world,
+                             all_workers=cfg.checkpoint_all)
+
+
+def _multihost_env() -> bool:
+    """Join a cluster when launched by SLURM with >1 task or when an
+    explicit coordinator is configured (gossip_sgd.py:599-605)."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return True
+    try:
+        return int(os.environ.get("SLURM_NTASKS", "1")) > 1
+    except ValueError:
+        return False
 
 
 def _default_requeue() -> str | None:

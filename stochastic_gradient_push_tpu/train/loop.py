@@ -9,6 +9,7 @@ the reference's plotting layer parses these logs unchanged.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import time
@@ -17,11 +18,21 @@ import typing as tp
 import jax
 import numpy as np
 
+from jax.sharding import PartitionSpec as P
+
 from ..algorithms import GossipAlgorithm, adpsgd, all_reduce, dpsgd, sgp
 from ..parallel.mesh import GOSSIP_AXIS, LOCAL_AXIS, NODE_AXIS
+from ..parallel.multihost import (
+    global_state_from_local,
+    host_local_slice,
+    make_global_batch,
+    owned_ranks,
+    to_host,
+)
 from ..topology import build_pairing_schedule, build_schedule
 from ..utils import Meter, make_logger
 from ..utils.checkpoint import ClusterManager
+from ..utils.profiling import StepWatchdog
 from .lr import CosineLRSchedule, LRSchedule, ppi_at_epoch
 from .state import init_train_state, sgd
 from .step import (
@@ -95,6 +106,9 @@ class TrainerConfig:
     scan_steps: int = 1
     # decode workers for streaming loaders (reported in the CSV preamble)
     num_dataloader_workers: int = 0
+    # heartbeat: log loudly when a blocking step exceeds this many seconds
+    # (a stalled multi-host collective; ≙ distributed.py:36); 0 disables
+    heartbeat_timeout: int = 300
     # emit one CSV per gossip rank with that rank's metrics (the
     # reference's per-process files); off = one rank-averaged out_r0 file
     per_rank_csv: bool = False
@@ -124,7 +138,22 @@ class Trainer:
             self.gossip_axis = GOSSIP_AXIS
             self.local_axis = None
             self.gossip_world = self.world_size
-        self.log = make_logger("trainer", config.verbose)
+        # multi-host: this process feeds/owns only the gossip ranks whose
+        # devices it holds (one process per host on a pod slice,
+        # ≙ the reference's one-process-per-GPU layout, gossip_sgd.py:586-690)
+        self.proc_count = jax.process_count()
+        self.proc_index = jax.process_index()
+        if self.proc_count > 1:
+            if self.local_axis is not None:
+                from ..parallel.multihost import (
+                    HIERARCHICAL_IS_SINGLE_PROCESS)
+                raise NotImplementedError(HIERARCHICAL_IS_SINGLE_PROCESS)
+            self.local_ranks = owned_ranks(mesh, self.gossip_axis)
+        else:
+            self.local_ranks = list(range(self.gossip_world))
+        self.log = make_logger(f"trainer p{self.proc_index}"
+                               if self.proc_count > 1 else "trainer",
+                               config.verbose)
         self.cluster = cluster_manager
         self.sample_input_shape = sample_input_shape
 
@@ -138,9 +167,18 @@ class Trainer:
         # state of call 1 into device-sharded arrays from call 2 on
         self._warm_counts: dict = {}
         self._eval_fn = None
+        self._eval_alg = None
+        # heartbeat around the blocking step (≙ the reference's 300s gossip
+        # flag timeout, distributed.py:36,349-352): a dead peer host shows
+        # up as a hung collective, and silence is the worst failure mode
+        self.watchdog = (StepWatchdog(timeout=config.heartbeat_timeout,
+                                      rank=self.proc_index)
+                         if config.heartbeat_timeout > 0 else None)
 
-        self._csv_ranks = (range(self.gossip_world)
-                           if config.per_rank_csv else (0,))
+        # per-rank files: each process writes its local ranks; the single
+        # aggregate file is process 0's job
+        self._csv_ranks = (tuple(self.local_ranks) if config.per_rank_csv
+                           else ((0,) if self.proc_index == 0 else ()))
         self._fname = lambda r: os.path.join(
             config.checkpoint_dir,
             f"{config.tag}out_r{r}_n{self.world_size}.csv")
@@ -254,7 +292,17 @@ class Trainer:
         state = init_train_state(
             self.model, jax.random.PRNGKey(self.cfg.seed),
             jnp.zeros(self.sample_input_shape), self.tx, alg)
-        return replicate_state(state, self.gossip_world)
+        if self.proc_count == 1:
+            return replicate_state(state, self.gossip_world)
+        # every rank starts identical (same seed, gossip_sgd.py:172-175);
+        # each process materializes only its local rows and assembles the
+        # global sharded state from them
+        local = jax.tree.map(
+            lambda a: np.broadcast_to(
+                np.asarray(a)[None],
+                (len(self.local_ranks),) + np.shape(a)).copy(),
+            state)
+        return global_state_from_local(self.mesh, self.gossip_axis, local)
 
     def fit(self, state, train_loader, sampler,
             val_loader=None) -> tuple[tp.Any, dict]:
@@ -292,7 +340,7 @@ class Trainer:
 
         if cfg.resume and self.cluster is not None \
                 and self.cluster.ckpt.exists():
-            state, meta = self.cluster.ckpt.restore(state)
+            state, meta = self._restore(state)
             start_epoch = meta.get("epoch", 0)
             start_itr = meta.get("itr", 0)
             best_prec1 = meta.get("best_prec1", 0.0)
@@ -317,11 +365,12 @@ class Trainer:
             start_itr = 0
 
             if not cfg.train_fast:
-                spread = replica_spread(state, alg)
-                self.log.info(
-                    f"epoch {epoch}: replica spread "
-                    f"max {spread['max_spread']:.2e} "
-                    f"mean {spread['mean_spread']:.2e}")
+                if self.proc_count == 1:
+                    spread = replica_spread(state, alg)
+                    self.log.info(
+                        f"epoch {epoch}: replica spread "
+                        f"max {spread['max_spread']:.2e} "
+                        f"mean {spread['mean_spread']:.2e}")
                 prec1 = (self.validate(state, alg, val_loader)
                          if val_loader is not None else -1.0)
                 final_prec1 = prec1
@@ -341,8 +390,10 @@ class Trainer:
                         "data_meter": data_meter.state_dict(),
                     }
                     epoch_id = (None if cfg.overwrite_checkpoints else epoch)
+                    save_state = (host_local_slice(state)
+                                  if self.proc_count > 1 else state)
                     self.cluster.save_checkpoint(
-                        state, meta, epoch_id=epoch_id, is_best=is_best,
+                        save_state, meta, epoch_id=epoch_id, is_best=is_best,
                         requeue_on_signal=(epoch != cfg.num_epochs - 1))
 
         if cfg.train_fast and val_loader is not None:
@@ -356,6 +407,23 @@ class Trainer:
                        "final_prec1": float(final_prec1),
                        "elapsed_time": time.time() - begin_time,
                        "batch_meter": batch_meter}
+
+    def _restore(self, state):
+        """Checkpoint restore; multi-host restores this process's rank rows
+        from its own file and reassembles the global state."""
+        if self.proc_count == 1:
+            return self.cluster.ckpt.restore(state)
+        local_tmpl = host_local_slice(state)
+        local_state, meta = self.cluster.ckpt.restore(local_tmpl)
+        return (global_state_from_local(self.mesh, self.gossip_axis,
+                                        local_state), meta)
+
+    def _batch_spec(self, scanned: bool) -> P:
+        """The train step's batch partition spec (must mirror
+        shard_train_step / shard_scanned_train_step)."""
+        axes = (self.gossip_axis if self.local_axis is None
+                else (self.gossip_axis, self.local_axis))
+        return P(None, axes) if scanned else P(axes)
 
     def _train_epoch(self, state, ppi, itr_per_epoch, loader, epoch,
                      start_itr, meters):
@@ -438,14 +506,31 @@ class Trainer:
                 y = np.stack([b[1] for b in pending])
             else:
                 x, y = pending[0]
+            if self.proc_count > 1:
+                # loader rows cover only this process's ranks; assemble
+                # the global array (per-process feeding on a pod)
+                spec = self._batch_spec(scanned=chunk > 1)
+                x = make_global_batch(self.mesh, spec, x)
+                y = make_global_batch(self.mesh, spec, y)
             elapsed_data = time.time() - batch_time  # includes host stacking
             nn_time = time.time()
             warm_key = (ppi, itr_per_epoch, chunk, np.shape(x))
             timed = self._warm_counts.get(warm_key, 0) >= 2
             self._warm_counts[warm_key] = \
                 self._warm_counts.get(warm_key, 0) + 1
-            state, metrics = train_fn(state, x, y)
-            jax.block_until_ready(state)
+            # arm the heartbeat only on warm steps: the first calls of a
+            # variant carry XLA compilation, which can legitimately exceed
+            # any sane step timeout
+            guard = (self.watchdog.step()
+                     if self.watchdog is not None and timed
+                     else contextlib.nullcontext())
+            with guard:
+                state, metrics = train_fn(state, x, y)
+                jax.block_until_ready(state)
+            if self.proc_count > 1:
+                # metrics come back sharded across hosts; all-gather the
+                # tiny per-rank vectors so every process logs full rows
+                metrics = to_host(metrics, self.mesh)
             # metrics: [world] for a single step, [world, chunk] when
             # scanned — normalize to [world, chunk]
             to_arr = lambda m: np.asarray(m).reshape(
@@ -469,23 +554,37 @@ class Trainer:
     def validate(self, state, algorithm, val_loader) -> float:
         """Every rank evaluates the full val set independently
         (gossip_sgd.py:440-471); returns mean top-1 across ranks."""
-        if self._eval_fn is None:
+        # cache keyed on the algorithm: eval_params differs between
+        # algorithm instances (e.g. a ppi_schedule rebuilds the algorithm),
+        # so a stale compiled eval must not be reused across them
+        if self._eval_fn is None or self._eval_alg is not algorithm:
             eval_step = build_eval_step(self.model, algorithm,
                                         self.cfg.num_classes)
             self._eval_fn = shard_eval_step(
                 eval_step, self.mesh, self.gossip_axis, self.local_axis)
+            self._eval_alg = algorithm
         losses = Meter(ptag="Loss")
         top1 = Meter(ptag="Prec@1")
         top5 = Meter(ptag="Prec@5")
         rank_top1 = np.zeros(self.gossip_world)
-        n_batches = 0
+        n_batches, n_samples = 0, 0
         for x, y in val_loader:
+            if self.proc_count > 1:
+                spec = self._batch_spec(scanned=False)
+                x = make_global_batch(self.mesh, spec, x)
+                y = make_global_batch(self.mesh, spec, y)
             m = self._eval_fn(state, x, y)
+            if self.proc_count > 1:
+                m = to_host(m, self.mesh)
             n = x.shape[0] * x.shape[1]
             losses.update(float(np.mean(m["loss"])), n)
             top1.update(float(np.mean(m["top1"])), n)
             top5.update(float(np.mean(m["top5"])), n)
-            rank_top1 += np.asarray(m["top1"]).reshape(self.gossip_world)
+            # sample-weighted like the aggregate Meter, so per-rank and
+            # averaged val columns agree under variable batch sizes
+            rank_top1 += np.asarray(m["top1"]).reshape(
+                self.gossip_world) * n
+            n_samples += n
             n_batches += 1
         if n_batches == 0:
             self.log.warning(
@@ -493,7 +592,7 @@ class Trainer:
                 "than one world batch?) — reporting -1")
             self._last_val_per_rank = [-1.0] * self.gossip_world
             return -1.0
-        self._last_val_per_rank = (rank_top1 / n_batches).tolist()
+        self._last_val_per_rank = (rank_top1 / n_samples).tolist()
         self.log.info(
             f" * Prec@1 {top1.avg:.3f} Prec@5 {top5.avg:.3f}")
         return top1.avg

@@ -18,8 +18,12 @@ gossip_sgd.py:306-315) to the explicit-state world:
   request, the manager invokes a user-supplied relaunch command
   (``scontrol requeue`` under SLURM, ≙ cluster_manager.py:105-118).
 
-Serialization uses ``flax.serialization`` msgpack over the raw state pytree
-plus a JSON metadata sidecar (epoch, itr, meters, best metric).
+Serialization uses ``flax.serialization`` msgpack over a single payload
+``{"state": ..., "meta": ...}`` written with one atomic rename — state and
+metadata (epoch, itr, meters, best metric) can never disagree, which a
+two-file layout could not guarantee (a crash between the two renames would
+pair a new state with the previous epoch's metadata).  Legacy two-file
+checkpoints (state + ``.meta.json`` sidecar) are still readable.
 """
 
 from __future__ import annotations
@@ -66,21 +70,16 @@ class CheckpointManager:
              is_best: bool = False) -> str:
         path = self.path_for_epoch(epoch_id)
         state = jax.tree.map(np.asarray, state)
+        # one payload, one rename: state and meta are atomic together
+        payload = {"state": flax.serialization.to_state_dict(state),
+                   "meta": json.loads(json.dumps(meta, default=float))}
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(flax.serialization.to_bytes(state))
+            f.write(flax.serialization.msgpack_serialize(payload))
         os.replace(tmp, path)
-        # meta is written atomically too: a crash between the two writes must
-        # not pair a new checkpoint with the previous epoch's metadata
-        meta_tmp = path + ".meta.json.tmp"
-        with open(meta_tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(meta_tmp, path + ".meta.json")
         if path != self.checkpoint_path:
             # keep the canonical resume path pointing at the newest save
             shutil.copyfile(path, self.checkpoint_path)
-            shutil.copyfile(path + ".meta.json",
-                            self.checkpoint_path + ".meta.json")
         if is_best:
             shutil.copyfile(path, self.best_path)
         return path
@@ -91,7 +90,14 @@ class CheckpointManager:
     def restore(self, state_template) -> tuple[tp.Any, dict]:
         """Restore into the structure of ``state_template``."""
         with open(self.checkpoint_path, "rb") as f:
-            state = flax.serialization.from_bytes(state_template, f.read())
+            blob = f.read()
+        raw = flax.serialization.msgpack_restore(blob)
+        if isinstance(raw, dict) and set(raw) == {"state", "meta"}:
+            state = flax.serialization.from_state_dict(
+                state_template, raw["state"])
+            return state, raw["meta"]
+        # legacy layout: the file is the bare state, meta in a sidecar
+        state = flax.serialization.from_bytes(state_template, blob)
         meta_path = self.checkpoint_path + ".meta.json"
         meta = {}
         if os.path.isfile(meta_path):
@@ -161,6 +167,8 @@ class ClusterManager:
         if requeue_on_signal and self.any_rank_signalled():
             self.logger.info(
                 "At least 1 process received SIGUSR1. Terminating")
+            if hasattr(self.ckpt, "wait"):
+                self.ckpt.wait()  # async backends: land the save first
             if self.rank == 0 and self.requeue_command:
                 self.logger.info("Relaunching: " + self.requeue_command)
                 if os.system(self.requeue_command):

@@ -68,8 +68,8 @@ class StepWatchdog:
                 elapsed = time.monotonic() - start
                 self.logger.error(
                     f"step exceeded heartbeat timeout "
-                    f"({elapsed:.0f}s > {self.timeout}s) — a peer host may "
-                    "be unreachable")
+                    f"({elapsed:.0f}s > {self.timeout}s) — device stall, "
+                    "or an unreachable peer host on multi-host runs")
                 if self.abort_on_timeout:
                     import os
                     os._exit(70)
