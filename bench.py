@@ -289,7 +289,9 @@ def main():
     env["BENCH_STEPS"] = "3"
     env["BENCH_SCAN"] = "1"
     env["BENCH_PHASES"] = "0"
-    result, err = _attempt(env, timeout=600)
+    # fallback respects the overall deadline too (min 60s to be useful)
+    remaining = deadline - (time.monotonic() - start)
+    result, err = _attempt(env, timeout=min(600.0, max(60.0, remaining)))
     if result is None:
         errors.append(f"cpu fallback: {err}")
     if result is None:

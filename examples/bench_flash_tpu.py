@@ -1,0 +1,102 @@
+"""Real-TPU flash-attention kernel benchmark: Pallas vs XLA attention.
+
+Runs the fused forward+backward Pallas kernels on the TPU (NOT interpret
+mode), checks numerics against the pure-JAX blockwise oracle, and times
+them against XLA's materialized attention.  Emits one JSON line per config
+and writes a summary table to stdout.
+
+Usage (needs the real chip): python examples/bench_flash_tpu.py
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stochastic_gradient_push_tpu.ops.flash_attention import flash_attention
+from stochastic_gradient_push_tpu.parallel.ring_attention import (
+    blockwise_attention,
+)
+
+STEPS = 20
+
+
+def xla_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhvd".replace("v", "q"), p, v)
+
+
+def timed(fn, *args):
+    r = fn(*args)
+    _ = np.asarray(jax.device_get(jax.tree.leaves(r)[0]))[..., 0, 0]
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        r = fn(*args)
+    _ = np.asarray(jax.device_get(jax.tree.leaves(r)[0]))[..., 0, 0]
+    return (time.perf_counter() - t0) / STEPS * 1e3  # ms
+
+
+def run(b, h, t, d, causal=True, dtype=jnp.bfloat16):
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, t, d)) * 0.5, dtype)
+               for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, min(128, t),
+                                           causal=causal)
+                       .astype(jnp.float32) ** 2)
+
+    fwd_flash = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal))
+    fwd_xla = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal))
+    bwd_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    bwd_xla = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))
+    bwd_oracle = jax.jit(jax.grad(loss_oracle, argnums=(0, 1, 2)))
+
+    # numerics vs oracle (fp32 compare)
+    out_f = np.asarray(fwd_flash(q, k, v), np.float32)
+    out_o = np.asarray(jax.jit(lambda q, k, v: blockwise_attention(
+        q, k, v, min(128, t), causal=causal))(q, k, v), np.float32)
+    fwd_err = float(np.max(np.abs(out_f - out_o)))
+    gf = bwd_flash(q, k, v)
+    go = bwd_oracle(q, k, v)
+    bwd_err = max(float(np.max(np.abs(np.asarray(a, np.float32)
+                                      - np.asarray(b, np.float32))))
+                  for a, b in zip(gf, go))
+
+    r = {
+        "shape": f"b{b} h{h} t{t} d{d} causal={causal}",
+        "fwd_flash_ms": round(timed(fwd_flash, q, k, v), 3),
+        "fwd_xla_ms": round(timed(fwd_xla, q, k, v), 3),
+        "bwd_flash_ms": round(timed(bwd_flash, q, k, v), 3),
+        "bwd_xla_ms": round(timed(bwd_xla, q, k, v), 3),
+        "fwd_max_err": fwd_err,
+        "bwd_max_err": bwd_err,
+    }
+    print(json.dumps(r), flush=True)
+    return r
+
+
+if __name__ == "__main__":
+    print(f"backend: {jax.default_backend()} "
+          f"({jax.devices()[0].device_kind})", flush=True)
+    assert jax.default_backend() == "tpu", "needs the real chip"
+    for t in (1024, 2048, 4096):
+        run(4, 8, t, 64, causal=True)
+    run(4, 8, 2048, 64, causal=False)

@@ -50,20 +50,36 @@ class PushSumGossip(GossipAlgorithm):
 
     Overlap (overlap=True, ≙ OSGP, distributed.py:571-588): ``post_step``
     keeps only the local share ``lo·x`` and stores the peers' contributions
-    in ``state.in_flight``; ``pre_step`` of the *next* iteration adds them —
-    the same one-step staleness the reference gets from its gossip thread,
-    except the "thread" is XLA's collective scheduler overlapping the
-    ppermute with backprop compute.
+    in ``state.in_flight``; ``pre_step`` of a *later* iteration adds them —
+    the same staleness the reference gets from its gossip thread, except
+    the "thread" is XLA's collective scheduler overlapping the ppermute
+    with backprop compute.
+
+    ``staleness`` bounds how many steps an incoming share may ride in
+    flight (≙ ``synch_freq``: the reference polls non-blocking for up to N
+    steps before forcing a wait, distributed.py:127-129, :578, so its max
+    staleness is ``synch_freq+1``; here the bound is exact rather than
+    comm-speed-dependent).  ``in_flight`` becomes a FIFO of ``staleness``
+    slots: ``pre_step`` consumes the oldest, ``post_step`` appends the
+    round just launched.  Memory cost: ``staleness`` extra parameter
+    copies.  Every launched share is consumed exactly once, so push-sum
+    mass conservation is preserved for any staleness.
     """
 
     name = "sgp"
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, track_weight: bool = True,
-                 gossip_every: int = 1, comm_dtype=None):
+                 gossip_every: int = 1, comm_dtype=None,
+                 staleness: int = 1):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
+        if staleness < 1:
+            raise ValueError("staleness must be >= 1")
+        if staleness > 1 and not overlap:
+            raise ValueError("staleness is an overlap-mode knob")
+        self.staleness = staleness
         # push-pull (D-PSGD) reuses this machinery with no ps-weight
         self.track_weight = track_weight
         # communication thinning: gossip on every k-th step only (the
@@ -120,19 +136,32 @@ class PushSumGossip(GossipAlgorithm):
     def init(self, params: Params) -> GossipState:
         state = GossipState(phase=jnp.int32(0), ps_weight=jnp.float32(1.0))
         if self.overlap:
-            in_flight = (self._zeros_like_params(params), jnp.float32(0.0))
-            state = state.replace(in_flight=in_flight)
+            # FIFO of `staleness` (params, weight) slots, each holding one
+            # round's incoming share.  A tuple of slots (static pytree
+            # structure) rather than a stacked axis keeps the algorithm
+            # agnostic to how callers batch/shard the state leaves.
+            slot = lambda: (self._zeros_like_params(params),
+                            jnp.float32(0.0))
+            state = state.replace(
+                in_flight=tuple(slot() for _ in range(self.staleness)))
         return state
 
     def pre_step(self, params, state):
         if not self.overlap:
             return params, state
-        # consume the round launched last step (≙ _query_gossip_queue,
-        # distributed.py:336-387: p += r; ps_weight += gossip_ps_weight)
-        in_params, in_w = state.in_flight
-        params = jax.tree.map(jnp.add, params, in_params)
-        ps_weight = state.ps_weight + jnp.reshape(in_w, jnp.shape(state.ps_weight))
-        return params, state.replace(ps_weight=ps_weight)
+        # consume the OLDEST in-flight round (≙ _query_gossip_queue,
+        # distributed.py:336-387: p += r; ps_weight += gossip_ps_weight),
+        # then shift the FIFO; post_step fills the freed last slot
+        in_params, in_w = state.in_flight[0]
+        params = jax.tree.map(lambda p, b: p + b.astype(p.dtype),
+                              params, in_params)
+        ps_weight = state.ps_weight + jnp.reshape(
+            in_w, jnp.shape(state.ps_weight))
+        empty = (self._zeros_like_params(in_params),
+                 jnp.zeros_like(in_w))
+        in_flight = state.in_flight[1:] + (empty,)
+        return params, state.replace(ps_weight=ps_weight,
+                                     in_flight=in_flight)
 
     def eval_params(self, params, state):
         if not self.track_weight:
@@ -178,9 +207,11 @@ class PushSumGossip(GossipAlgorithm):
     def _finish_overlap(self, local_p, local_w, incoming, state, phase):
         local_w = jnp.reshape(jnp.asarray(local_w, jnp.float32),
                               jnp.shape(state.ps_weight))
+        # the just-launched round takes the FIFO's freed last slot
+        in_flight = state.in_flight[:-1] + (incoming,)
         return local_p, state.replace(phase=phase + 1,
                                       ps_weight=local_w,
-                                      in_flight=incoming)
+                                      in_flight=in_flight)
 
 
 class PushPullGossip(PushSumGossip):
@@ -199,12 +230,12 @@ class PushPullGossip(PushSumGossip):
     name = "dpsgd"
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
-                 overlap: bool = False):
+                 overlap: bool = False, staleness: int = 1):
         if not schedule.regular:
             raise ValueError("D-PSGD requires a regular schedule "
                              "(doubly-stochastic mixing)")
         super().__init__(schedule, axis_name, overlap=overlap,
-                         track_weight=overlap)
+                         track_weight=overlap, staleness=staleness)
 
 
 class BilateralGossip(GossipAlgorithm):
@@ -240,18 +271,22 @@ def all_reduce(axis_name: str) -> AllReduce:
 
 def sgp(schedule: GossipSchedule, axis_name: str,
         overlap: bool = False, gossip_every: int = 1,
-        comm_dtype=None) -> PushSumGossip:
+        comm_dtype=None, staleness: int = 1) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
-                         gossip_every=gossip_every, comm_dtype=comm_dtype)
+                         gossip_every=gossip_every, comm_dtype=comm_dtype,
+                         staleness=staleness)
 
 
-def osgp(schedule: GossipSchedule, axis_name: str) -> PushSumGossip:
-    return PushSumGossip(schedule, axis_name, overlap=True)
+def osgp(schedule: GossipSchedule, axis_name: str,
+         staleness: int = 1) -> PushSumGossip:
+    return PushSumGossip(schedule, axis_name, overlap=True,
+                         staleness=staleness)
 
 
 def dpsgd(schedule: GossipSchedule, axis_name: str,
-          overlap: bool = False) -> PushPullGossip:
-    return PushPullGossip(schedule, axis_name, overlap=overlap)
+          overlap: bool = False, staleness: int = 1) -> PushPullGossip:
+    return PushPullGossip(schedule, axis_name, overlap=overlap,
+                          staleness=staleness)
 
 
 def adpsgd(pairing: np.ndarray, axis_name: str) -> BilateralGossip:

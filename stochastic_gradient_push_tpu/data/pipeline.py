@@ -114,19 +114,21 @@ class ShardedLoader:
 
 def synthetic_classification(n: int, num_classes: int = 10,
                              image_size: int = 16, channels: int = 3,
-                             seed: int = 0,
+                             seed: int = 0, noise: float = 0.5,
                              dtype=np.float32) -> tuple[np.ndarray, np.ndarray]:
     """Learnable synthetic image classification data.
 
     Each class has a fixed random mean image; samples are mean + noise, so a
     small model can fit them and smoke tests can assert loss decrease.
+    ``noise`` sets the per-pixel noise scale (class means have scale 1.0) —
+    raise it to make the task genuinely hard for convergence studies.
     """
     g = np.random.default_rng(seed)
     means = g.normal(scale=1.0,
                      size=(num_classes, image_size, image_size, channels))
     labels = g.integers(0, num_classes, size=(n,))
     images = means[labels] + g.normal(
-        scale=0.5, size=(n, image_size, image_size, channels))
+        scale=noise, size=(n, image_size, image_size, channels))
     return images.astype(dtype), labels.astype(np.int32)
 
 

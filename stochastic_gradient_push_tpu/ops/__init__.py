@@ -1,5 +1,10 @@
 """Pallas TPU kernels for hot ops."""
 
-from .flash_attention import flash_attention, flash_attention_forward
+from .flash_attention import (
+    flash_attention,
+    flash_attention_backward,
+    flash_attention_forward,
+)
 
-__all__ = ["flash_attention", "flash_attention_forward"]
+__all__ = ["flash_attention", "flash_attention_forward",
+           "flash_attention_backward"]

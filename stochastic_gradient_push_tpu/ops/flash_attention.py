@@ -1,20 +1,29 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention kernels: fused forward AND backward.
 
-The transformer path's compute hot spot.  One grid cell per
+The transformer path's compute hot spot.  Forward: one grid cell per
 (batch·head, q-block): the q block stays resident in VMEM while k/v blocks
 stream through, accumulating with the online-softmax recurrence — O(block²)
 VMEM instead of O(seq²) HBM, and causal upper-triangle blocks are skipped
-entirely (≈2× fewer FLOPs at long sequence).
+entirely (≈2× fewer FLOPs at long sequence).  The forward also emits the
+per-row logsumexp so the backward can recompute attention probabilities
+without a second softmax reduction.
 
-Differentiability: wrapped in ``jax.custom_vjp`` whose backward pass
-replays the pure-JAX blockwise implementation
-(parallel/ring_attention.py::blockwise_attention) under ``jax.vjp`` — the
-forward gets the kernel, the backward gets XLA's fused recompute, and both
-share one numerical reference that the tests pin down.
+Backward (``jax.custom_vjp``): two fused kernels in the standard
+flash-attention-2 decomposition —
+
+* dQ kernel, grid over (batch·head, q-block): streams k/v blocks,
+  recomputes ``p = exp(s - lse)``, accumulates ``dq += ds @ k``.
+* dK/dV kernel, grid over (batch·head, k-block): streams q/do blocks,
+  accumulates ``dv += pᵀ @ do`` and ``dk += dsᵀ @ q``.
+
+Both use ``delta = rowsum(do · o)`` (a cheap XLA elementwise reduce) in
+place of materializing dP.  Causal runs skip the empty triangle blocks in
+both kernels.
 
 On non-TPU backends ``flash_attention`` transparently falls back to the
-pure-JAX blockwise implementation (Pallas interpret mode exercises the
-kernel in tests).
+pure-JAX blockwise implementation
+(parallel/ring_attention.py::blockwise_attention); Pallas interpret mode
+exercises both kernels in tests against that same oracle.
 """
 
 from __future__ import annotations
@@ -27,15 +36,16 @@ from jax.experimental import pallas as pl
 
 from ..parallel.ring_attention import blockwise_attention
 
-__all__ = ["flash_attention", "flash_attention_forward"]
+__all__ = ["flash_attention", "flash_attention_forward",
+           "flash_attention_backward"]
 
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                   block_k: int, seq_len: int, causal: bool):
     """One (batch·head, q-block) cell.  Refs: q [block_q, d];
-    k/v [seq, d]; o [block_q, d]."""
+    k/v [seq, d]; o [block_q, d]; lse [block_q]."""
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     q = q_ref[:].astype(jnp.float32) * (d ** -0.5)
@@ -78,12 +88,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
         upper = num_k_blocks
     m, den, acc = jax.lax.fori_loop(0, upper, body, (m, den, acc))
     o_ref[:] = (acc / den[:, None]).astype(o_ref.dtype)
+    # per-row logsumexp of the scaled scores — the backward's residual
+    lse_ref[:] = m + jnp.log(den)
 
 
 def flash_attention_forward(q, k, v, causal: bool = False,
                             block_q: int = 128, block_k: int = 128,
-                            interpret: bool = False):
-    """Pallas forward.  q/k/v: ``[batch, heads, seq, head_dim]``."""
+                            interpret: bool = False,
+                            return_lse: bool = False):
+    """Pallas forward.  q/k/v: ``[batch, heads, seq, head_dim]``.
+
+    With ``return_lse`` also returns the row logsumexp ``[b, h, seq]``
+    (float32), the residual the fused backward kernels consume.
+    """
     b, h, t, d = q.shape
     block_q = min(block_q, t)
     block_k = min(block_k, t)
@@ -98,7 +115,7 @@ def flash_attention_forward(q, k, v, causal: bool = False,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_len=t,
         causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
         in_specs=[
@@ -106,12 +123,189 @@ def flash_attention_forward(q, k, v, causal: bool = False,
             pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(b, h, t, d)
+    if return_lse:
+        return out, lse.reshape(b, h, t)
+    return out
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, *, block_q: int, block_k: int, seq_len: int,
+                     causal: bool):
+    """dQ cell: one (batch·head, q-block); k/v/do stream through.
+    Refs: q/do/dq [block_q, d]; k/v [seq, d]; lse/delta [block_q]."""
+    qi = pl.program_id(1)
+    d = q_ref.shape[-1]
+    scale = d ** -0.5
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+
+    num_k_blocks = seq_len // block_k
+    dq = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kj, dq):
+        k_blk = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    if causal:
+        last_block = qi * block_q // block_k + \
+            (block_q + block_k - 1) // block_k
+        upper = jnp.minimum(num_k_blocks, last_block)
+    else:
+        upper = num_k_blocks
+    dq = jax.lax.fori_loop(0, upper, body, dq)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, block_q: int, block_k: int,
+                      seq_len: int, causal: bool):
+    """dK/dV cell: one (batch·head, k-block); q/do stream through.
+    Refs: k/v/dk/dv [block_k, d]; q/do [seq, d]; lse/delta [seq]."""
+    kj = pl.program_id(1)
+    d = k_ref.shape[-1]
+    scale = d ** -0.5
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    num_q_blocks = seq_len // block_q
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[pl.ds(qi * block_q, block_q)]
+        delta_blk = delta_ref[pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])              # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        return dk, dv
+
+    if causal:
+        # the first q block whose rows can see this k block
+        lower = (kj * block_k) // block_q
+    else:
+        lower = 0
+    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: bool = False):
+    """Fused Pallas backward: returns ``(dq, dk, dv)``.
+
+    ``lse`` is the forward's row logsumexp ``[b, h, seq]``; ``delta`` is
+    computed here as ``rowsum(do · out)`` (one cheap XLA reduce).
+    """
+    b, h, t, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"seq {t}")
+
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    dof = do.reshape(b * h, t, d)
+    lsef = lse.reshape(b * h, t)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, t)
+
+    row_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
+        pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # k
+        pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # v
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # do
+        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),        # lse
+        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),        # δ
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_q=block_q,
+                          block_k=block_k, seq_len=t, causal=causal),
+        grid=(b * h, t // block_q),
+        in_specs=row_specs,
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    )(qf, kf, vf, dof, lsef, delta)
+
+    col_specs = [
+        pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # q
+        pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # k
+        pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # v
+        pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # do
+        pl.BlockSpec((None, t), lambda bh, kj: (bh, 0)),               # lse
+        pl.BlockSpec((None, t), lambda bh, kj: (bh, 0)),               # δ
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q,
+                          block_k=block_k, seq_len=t, causal=causal),
+        grid=(b * h, t // block_k),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+            dv.reshape(b, h, t, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -121,18 +315,16 @@ def _flash(q, k, v, causal, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    out = flash_attention_forward(q, k, v, causal=causal,
-                                  block_q=block_q, block_k=block_k)
-    return out, (q, k, v)
+    out, lse = flash_attention_forward(q, k, v, causal=causal,
+                                       block_q=block_q, block_k=block_k,
+                                       return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, residuals, g):
-    q, k, v = residuals
-    block = min(block_k, q.shape[2])  # forward clamps too
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(q, k, v, block,
-                                            causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return flash_attention_backward(q, k, v, out, lse, g, causal=causal,
+                                    block_q=block_q, block_k=block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

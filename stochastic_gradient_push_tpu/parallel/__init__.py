@@ -10,6 +10,7 @@ from .mesh import (
 from .averaging import consensus_error, push_sum_average
 from .discovery import ClusterInfo, discover, initialize_multihost
 from .multihost import (
+    consensus_resume_point,
     global_state_from_local,
     host_local_slice,
     make_global_batch,
@@ -40,6 +41,7 @@ __all__ = [
     "to_host",
     "host_local_slice",
     "global_state_from_local",
+    "consensus_resume_point",
     "gossip_round",
     "mix_push_sum",
     "mix_push_pull",

@@ -33,8 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = ["owned_ranks", "make_global_batch", "to_host",
            "host_local_slice", "global_state_from_local",
-           "process_count", "process_index",
-           "HIERARCHICAL_IS_SINGLE_PROCESS"]
+           "consensus_resume_point", "HIERARCHICAL_IS_SINGLE_PROCESS"]
 
 # single source of truth for the guard raised at both the CLI and the
 # Trainer boundary
@@ -43,12 +42,25 @@ HIERARCHICAL_IS_SINGLE_PROCESS = (
     "use the flat gossip mesh on pods")
 
 
-def process_count() -> int:
-    return jax.process_count()
+def consensus_resume_point(epoch: int, itr: int) -> tuple[int, int]:
+    """Agree on one resume point across processes.
 
+    Per-process checkpoint files can tear under preemption (one host saved
+    epoch N, another died at N-1).  Every process must run the same number
+    of epoch loops or the compiled collectives deadlock, so resume from the
+    *minimum* (epoch, itr) any process holds — re-running a stretch of data
+    on the ahead processes is harmless (their state simply trains on), a
+    mismatched collective count is fatal.
+    """
+    if jax.process_count() == 1:
+        return epoch, itr
+    from jax.experimental import multihost_utils
 
-def process_index() -> int:
-    return jax.process_index()
+    mine = np.asarray([epoch, itr], np.int64)
+    all_pts = np.asarray(
+        multihost_utils.process_allgather(mine)).reshape(-1, 2)
+    e, i = min((int(r[0]), int(r[1])) for r in all_pts)
+    return e, i
 
 
 def owned_ranks(mesh: Mesh, axis: str) -> list[int]:

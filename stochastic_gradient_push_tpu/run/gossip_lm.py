@@ -122,10 +122,11 @@ def main(argv=None):
         raise SystemExit("--sp, --tp and --ep must be >= 1")
     if ep > 1 and (sp > 1 or tp > 1):
         raise SystemExit("--ep composes with gossip DP only (no --sp/--tp)")
-    if args.moe_experts and sp > 1:
-        raise SystemExit(
-            "--moe_experts with ring sequence parallelism is unsupported "
-            "(per-block routing semantics untested; see ROADMAP.md)")
+    # --moe_experts with --sp > 1 (no ep): per-block routing — every
+    # sequence shard routes its own block's tokens with per-block capacity;
+    # expert weights are replicated over seq.  Routing is per-token, so
+    # with enough capacity this matches global routing exactly
+    # (tests/test_moe.py::test_moe_ring_per_block_routing_parity).
     if ep > 1 and not args.moe_experts:
         raise SystemExit("--ep requires --moe_experts > 0")
     if args.moe_experts and args.moe_experts % ep:
@@ -147,10 +148,23 @@ def main(argv=None):
     else:
         mesh = make_dp_sp_mesh(dp, sp)
 
+    def _flash_ok(seq_len: int) -> bool:
+        # the pallas kernel needs the (clamped) 128 block to divide seq_len
+        return seq_len % min(128, seq_len) == 0
+
     attn = args.attn
     if attn is None:
         attn = "ring" if sp > 1 else (
             "flash" if jax.default_backend() == "tpu" else "full")
+        if attn == "flash" and not _flash_ok(args.seq_len):
+            log.info(f"seq_len {args.seq_len} not divisible by the flash "
+                     "kernel block; falling back to blockwise attention")
+            attn = "blockwise"
+    elif attn == "flash" and not _flash_ok(args.seq_len):
+        raise SystemExit(
+            f"--attn flash needs seq_len divisible by "
+            f"{min(128, args.seq_len)} (got {args.seq_len}); use "
+            "--attn blockwise or a padded seq_len")
     if sp > 1 and attn != "ring":
         raise SystemExit("--sp > 1 requires ring attention")
     if tp > 1 and sp == 1 and attn == "ring":

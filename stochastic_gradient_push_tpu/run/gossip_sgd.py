@@ -62,7 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--overlap", default="False", type=str)
     p.add_argument("--synch_freq", default=0, type=int,
-                   help="accepted for compatibility; staleness is one step")
+                   help="overlap-mode staleness bound: in-flight gossip is "
+                        "consumed synch_freq+1 steps after launch "
+                        "(reference semantics: up to N non-blocking polls, "
+                        "distributed.py:127-129)")
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step only (communication "
                         "thinning; sync push-sum mode)")
@@ -177,6 +180,7 @@ def parse_config(argv=None):
         all_reduce=all_reduce,
         push_sum=_str_bool(args.push_sum),
         overlap=_str_bool(args.overlap),
+        synch_freq=args.synch_freq,
         bilat=getattr(args, "bilat", False),
         graph_class=GRAPH_TOPOLOGIES[args.graph_type],
         mixing_class=MIXING_STRATEGIES[args.mixing_strategy],

@@ -57,6 +57,9 @@ class TrainerConfig:
     all_reduce: bool = False
     push_sum: bool = True
     overlap: bool = False
+    # bounded staleness for overlap mode: in-flight gossip is consumed
+    # synch_freq+1 steps after launch (≙ synch_freq, distributed.py:127-129)
+    synch_freq: int = 0
     # gossip on every k-th step (communication thinning, sync mode)
     gossip_every: int = 1
     # wire dtype for gossip payloads: None = leaf dtype, "bf16" halves
@@ -212,13 +215,21 @@ class Trainer:
             return adpsgd(build_pairing_schedule(graph), axis)
         mixing = cfg.mixing_class() if cfg.mixing_class else None
         schedule = build_schedule(graph, mixing)
+        staleness = (cfg.synch_freq + 1) if cfg.overlap else 1
+        if cfg.synch_freq and not cfg.overlap:
+            # the reference likewise only reads synch_freq under overlap
+            # (distributed.py:578); accept-and-ignore keeps launch scripts
+            # flag-compatible
+            self.log.warning("synch_freq is ignored without overlap mode")
         if cfg.push_sum:
             return sgp(schedule, axis, overlap=cfg.overlap,
                        gossip_every=cfg.gossip_every,
-                       comm_dtype=self._comm_dtype())
+                       comm_dtype=self._comm_dtype(),
+                       staleness=staleness)
         if cfg.gossip_every != 1:
             raise ValueError("gossip_every is a push-sum knob")
-        return dpsgd(schedule, axis, overlap=cfg.overlap)
+        return dpsgd(schedule, axis, overlap=cfg.overlap,
+                     staleness=staleness)
 
     def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):
         """Compiled step for a peers-per-itr value; each distinct
@@ -343,6 +354,13 @@ class Trainer:
             state, meta = self._restore(state)
             start_epoch = meta.get("epoch", 0)
             start_itr = meta.get("itr", 0)
+            if self.proc_count > 1:
+                # per-process checkpoints can tear under preemption; every
+                # process must agree on the loop counts or the compiled
+                # collectives deadlock
+                from ..parallel.multihost import consensus_resume_point
+                start_epoch, start_itr = consensus_resume_point(
+                    start_epoch, start_itr)
             best_prec1 = meta.get("best_prec1", 0.0)
             elapsed = meta.get("elapsed_time", 0.0)
             for m, k in zip(meters, ("batch_meter", "nn_meter",

@@ -121,12 +121,16 @@ class ClusterManager:
         self._flag_path = os.path.join(
             self.ckpt.directory, f"{self.ckpt.tag}.preempt_flag")
         # a stale flag from a killed run must not make the requeued job
-        # checkpoint-and-exit again after its first epoch
-        if rank == 0:
-            try:
-                os.remove(self._flag_path)
-            except OSError:
-                pass
+        # checkpoint-and-exit again after its first epoch.  EVERY process
+        # clears at init (all start before any save can check the flag);
+        # the flag is deliberately NOT removed at exit — exit-time removal
+        # raced multi-process shutdown: the first process out deleted it
+        # before its peers had seen it, and they kept training into dead
+        # collectives.
+        try:
+            os.remove(self._flag_path)
+        except OSError:
+            pass
         if install_handlers:
             self.install_signal_handlers()
 
@@ -174,8 +178,6 @@ class ClusterManager:
                 if os.system(self.requeue_command):
                     raise RuntimeError("requeue command failed")
                 self.logger.info("New job submitted to the queue")
-            try:
-                os.remove(self._flag_path)
-            except OSError:
-                pass
+            # the flag stays on disk so every peer process also sees it and
+            # exits; the requeued job clears it at ClusterManager init
             raise SystemExit(0)

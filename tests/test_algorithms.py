@@ -184,11 +184,14 @@ def test_osgp_mass_conservation_with_in_flight(mesh):
     total0 = X0.sum(axis=0)
     for _ in range(17):
         params, gstate = f(params, gstate, TARGETS)
-        in_p, in_w = gstate.in_flight
-        total = np.asarray(params).sum(axis=0) + np.asarray(in_p).sum(axis=0)
+        # in-flight is a FIFO of (params, weight) slots — sum all slots
+        in_p_total = sum(np.asarray(p).sum(axis=0)
+                         for p, _ in gstate.in_flight)
+        total = np.asarray(params).sum(axis=0) + in_p_total
         np.testing.assert_allclose(total, total0, rtol=1e-4, atol=1e-4)
         # ps-weight mass likewise: Σ(w + in_w) == WORLD
-        w_total = np.asarray(gstate.ps_weight).sum() + np.asarray(in_w).sum()
+        w_total = np.asarray(gstate.ps_weight).sum() + sum(
+            np.asarray(w).sum() for _, w in gstate.in_flight)
         np.testing.assert_allclose(w_total, WORLD, rtol=1e-5)
 
     # with lr=0 the de-biased estimates converge to the initial mean
@@ -215,9 +218,75 @@ def test_osgp_one_step_staleness_vs_sync(mesh):
 
     p_sync, _ = f_sync(X0, gs_sync, TARGETS)
     p_over, gs_over = f_over(X0, gs_over, TARGETS)
-    in_p, _ = gs_over.in_flight
+    in_p, _ = gs_over.in_flight[0]
     np.testing.assert_allclose(np.asarray(p_over) + np.asarray(in_p),
                                np.asarray(p_sync), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("staleness", [2, 3])
+def test_osgp_bounded_staleness(mesh, staleness):
+    """synch_freq analogue: incoming shares ride `staleness` steps in a
+    FIFO.  Mass stays conserved for any staleness, consensus still holds,
+    and the slot actually consumed is the oldest one."""
+    graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(graph)
+    alg = osgp(sched, GOSSIP_AXIS, staleness=staleness)
+    f = make_runner(alg, mesh, lr=0.0)
+
+    params = X0.copy()
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+    assert len(gstate.in_flight) == staleness
+    total0 = X0.sum(axis=0)
+    for _ in range(11):
+        params, gstate = f(params, gstate, TARGETS)
+        in_p_total = sum(np.asarray(p).sum(axis=0)
+                         for p, _ in gstate.in_flight)
+        total = np.asarray(params).sum(axis=0) + in_p_total
+        np.testing.assert_allclose(total, total0, rtol=1e-4, atol=1e-4)
+        w_total = np.asarray(gstate.ps_weight).sum() + sum(
+            np.asarray(w).sum() for _, w in gstate.in_flight)
+        np.testing.assert_allclose(w_total, WORLD, rtol=1e-5)
+
+    # consensus with lr=0: de-biased params converge to the initial mean
+    # (staler mixing converges slower, so give it more rounds)
+    for _ in range(120 * staleness):
+        params, gstate = f(params, gstate, TARGETS)
+    z = debias(alg, np.asarray(params), gstate)
+    np.testing.assert_allclose(
+        z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=2e-3)
+
+
+def test_osgp_staleness_consumes_oldest_first(mesh):
+    """With staleness=2, after exactly two steps the round launched at
+    step 0 (and only it) has been folded back in."""
+    graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(graph)
+    alg = osgp(sched, GOSSIP_AXIS, staleness=2)
+    f = make_runner(alg, mesh, lr=0.0)
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+
+    p, gs = f(X0, gstate, TARGETS)
+    # slot 0 empty (nothing old enough yet), slot 1 = round 0's incoming
+    np.testing.assert_allclose(np.asarray(gs.in_flight[0][0]), 0.0,
+                               atol=1e-7)
+    assert np.abs(np.asarray(gs.in_flight[1][0])).max() > 0
+
+    # step 2 consumes slot 0 (still empty) and shifts round 0's share to
+    # the front; round 1's share takes the freed last slot
+    p2, gs2 = f(p, gs, TARGETS)
+    assert np.abs(np.asarray(gs2.in_flight[0][0])).max() > 0
+    assert np.abs(np.asarray(gs2.in_flight[1][0])).max() > 0
+
+    # step 3 folds round 0's share (launched at step 0) back into params:
+    # the round trip took exactly `staleness` = 2 steps
+    mass_before = (np.asarray(p2).sum(axis=0)
+                   + sum(np.asarray(b).sum(axis=0)
+                         for b, _ in gs2.in_flight))
+    p3, gs3 = f(p2, gs2, TARGETS)
+    mass_after = (np.asarray(p3).sum(axis=0)
+                  + sum(np.asarray(b).sum(axis=0)
+                        for b, _ in gs3.in_flight))
+    np.testing.assert_allclose(mass_after, mass_before, rtol=1e-4)
 
 
 def test_bilat_step_is_exact_pair_average(mesh):

@@ -90,3 +90,37 @@ def test_moe_lm_trains_with_gossip_and_ep():
               if any("router" in str(k) for k in p)][0]
     r = np.asarray(router)
     assert np.all(np.isfinite(r)) and np.abs(r).max() > 0
+
+
+def test_composition_fences_raise_clean_errors():
+    """Unsupported parallelism compositions fail at the CLI boundary with
+    actionable messages (ARCHITECTURE.md composition matrix)."""
+    import pytest
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    base = ["--world_size", "8", "--moe_experts", "4", "--num_steps", "1"]
+    with pytest.raises(SystemExit, match="gossip DP only"):
+        main(base + ["--ep", "2", "--sp", "2"])
+    with pytest.raises(SystemExit, match="gossip DP only"):
+        main(base + ["--ep", "2", "--tp", "2"])
+    with pytest.raises(SystemExit, match="requires --moe_experts"):
+        main(["--world_size", "8", "--ep", "2", "--num_steps", "1"])
+    with pytest.raises(SystemExit, match="ring"):
+        main(base + ["--ep", "2", "--attn", "ring"])
+
+
+def test_moe_with_ring_sp_trains(tmp_path):
+    """MoE composed with ring sequence parallelism (per-block routing)
+    trains end-to-end through the CLI."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    r = main(["--world_size", "8", "--sp", "2", "--moe_experts", "2",
+              "--moe_every", "2", "--seq_len", "32", "--d_model", "32",
+              "--n_layers", "2", "--n_heads", "4", "--d_ff", "32",
+              "--vocab_size", "32", "--batch_size", "2", "--num_steps", "4",
+              "--corpus_tokens", "20000",
+              "--checkpoint_dir", str(tmp_path)])
+    assert np.isfinite(r["final_loss"])

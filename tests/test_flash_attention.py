@@ -69,3 +69,58 @@ def test_flash_kernel_bf16(qkv):
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=3e-2, atol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [16, 32])
+def test_flash_backward_kernels_match_oracle(qkv, causal, block):
+    from stochastic_gradient_push_tpu.ops.flash_attention import (
+        flash_attention_backward)
+
+    q, k, v = qkv
+    out, lse = flash_attention_forward(q, k, v, causal=causal,
+                                       block_q=block, block_k=block,
+                                       interpret=True, return_lse=True)
+    rng = np.random.default_rng(3)
+    do = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    dq, dk, dv = flash_attention_backward(
+        q, k, v, out, lse, do, causal=causal, block_q=block,
+        block_k=block, interpret=True)
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, block, causal=causal),
+        q, k, v)
+    for got, want in zip((dq, dk, dv), vjp(do)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_backward_mixed_block_sizes(qkv):
+    from stochastic_gradient_push_tpu.ops.flash_attention import (
+        flash_attention_backward)
+
+    q, k, v = qkv
+    out, lse = flash_attention_forward(q, k, v, causal=True, block_q=16,
+                                       block_k=32, interpret=True,
+                                       return_lse=True)
+    rng = np.random.default_rng(4)
+    do = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    dq, dk, dv = flash_attention_backward(
+        q, k, v, out, lse, do, causal=True, block_q=16, block_k=32,
+        interpret=True)
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, 16, causal=True),
+        q, k, v)
+    for got, want in zip((dq, dk, dv), vjp(do)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_forward_lse_matches_reference(qkv):
+    q, k, v = qkv
+    _, lse = flash_attention_forward(q, k, v, causal=False, block_q=32,
+                                     block_k=32, interpret=True,
+                                     return_lse=True)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    want = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

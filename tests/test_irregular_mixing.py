@@ -160,7 +160,7 @@ def test_osgp_overlap_under_irregular_mixing(mesh):
         params, gstate = jax.block_until_ready(f(params, gstate))
 
     w = np.asarray(gstate.ps_weight).reshape(WORLD, 1)
-    in_p, in_w = gstate.in_flight
+    in_p, in_w = gstate.in_flight[0]
     # total mass conservation including in-flight shares
     np.testing.assert_allclose(
         np.asarray(params).sum(0) + np.asarray(in_p).sum(0),

@@ -62,7 +62,10 @@ def test_cluster_manager_preemption_flow(tmp_path):
     with pytest.raises(SystemExit):
         cluster.save_checkpoint(_state(), {"epoch": 1})
     assert marker.exists()
-    # flag file cleaned up afterwards
+    # the flag survives exit (peer processes must still see it) and is
+    # cleared by the requeued job's ClusterManager init
+    assert os.path.isfile(cluster._flag_path)
+    ClusterManager(cm, rank=0, install_handlers=False)
     assert not os.path.isfile(cluster._flag_path)
 
 
