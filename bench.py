@@ -171,19 +171,24 @@ def run_measurement() -> dict:
         jax.block_until_ready(state)
         return float(np.min(np.asarray(jax.device_get(metrics["loss"]))))
 
-    for _ in range(WARMUP):
-        state, metrics = run(state, x, y)
-        if serialize:
-            jax.block_until_ready(state)
-    fence(state, metrics)
+    def time_step(step_fn, st, warmup):
+        """Shared measurement discipline: warm up, fence, run STEPS timed
+        iterations, fence; returns (final state, loss, seconds)."""
+        m = None
+        for _ in range(warmup):
+            st, m = step_fn(st, x, y)
+            if serialize:
+                jax.block_until_ready(st)
+        fence(st, m)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            st, m = step_fn(st, x, y)
+            if serialize:
+                jax.block_until_ready(st)
+        loss = fence(st, m)
+        return st, loss, time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = run(state, x, y)
-        if serialize:
-            jax.block_until_ready(state)
-    loss = fence(state, metrics)
-    dt = time.perf_counter() - t0
+    state, loss, dt = time_step(run, state, WARMUP)
     if not np.isfinite(loss):
         raise RuntimeError(f"non-finite loss {loss} — benchmark invalid")
 
@@ -213,6 +218,29 @@ def run_measurement() -> dict:
         mfu = (flops_per_itr / time_per_itr) / (peak * 1e12 * world)
         out["mfu"] = round(mfu, 4)
         out["tflops_per_itr"] = round(flops_per_itr / 1e12, 3)
+
+    if os.environ.get("BENCH_AR", "1") == "1":
+        # secondary metric (BASELINE.json): SGP-vs-AR step latency — the
+        # same step with exact AllReduce in place of the gossip round
+        from stochastic_gradient_push_tpu.algorithms import all_reduce
+
+        ar_step = build_train_step(model, all_reduce(GOSSIP_AXIS), tx,
+                                   lr_sched, itr_per_epoch=1000,
+                                   num_classes=1000)
+        if SCAN > 1:
+            ar_fn = shard_scanned_train_step(ar_step, mesh, n_steps=SCAN)
+        else:
+            ar_fn = shard_train_step(ar_step, mesh)
+        ar_state = replicate_state(
+            init_train_state(model, jax.random.PRNGKey(0),
+                             jnp.zeros((BATCH, IMAGE, IMAGE, 3),
+                                       jnp.float32),
+                             tx, all_reduce(GOSSIP_AXIS)),
+            world)
+        _, _, ar_dt = time_step(ar_fn, ar_state, max(2, WARMUP // 2))
+        ar_ms = ar_dt / (STEPS * SCAN) * 1e3
+        out["ar_step_ms"] = round(ar_ms, 3)
+        out["gossip_overhead_ms"] = round(time_per_itr * 1e3 - ar_ms, 3)
 
     if os.environ.get("BENCH_PHASES", "1") == "1":
         # forward-only latency on de-biased params: localizes perf between

@@ -108,8 +108,9 @@ def main(argv=None):
     from ..train.lm import (EP_AXIS, SEQ_AXIS, build_lm_train_step,
                             ep_state_specs, init_lm_state,
                             init_lm_state_ep, make_dp_ep_mesh,
-                            make_dp_sp_mesh, make_dp_sp_tp_mesh,
-                            make_dp_tp_mesh, shard_lm_train_step)
+                            make_dp_ep_sp_mesh, make_dp_sp_mesh,
+                            make_dp_sp_tp_mesh, make_dp_tp_mesh,
+                            shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
     from ..utils import Meter, make_logger
     from .gossip_sgd import _str_bool as sb
@@ -120,8 +121,10 @@ def main(argv=None):
     sp, tp, ep = args.sp, args.tp, args.ep
     if sp < 1 or tp < 1 or ep < 1:
         raise SystemExit("--sp, --tp and --ep must be >= 1")
-    if ep > 1 and (sp > 1 or tp > 1):
-        raise SystemExit("--ep composes with gossip DP only (no --sp/--tp)")
+    if ep > 1 and tp > 1:
+        raise SystemExit("--ep does not compose with --tp (expert-slice "
+                         "kernels cannot be simultaneously ep-manual and "
+                         "tp-auto on the same dims)")
     # --moe_experts with --sp > 1 (no ep): per-block routing — every
     # sequence shard routes its own block's tokens with per-block capacity;
     # expert weights are replicated over seq.  Routing is per-token, so
@@ -139,7 +142,9 @@ def main(argv=None):
     dp = world // (sp * tp * ep)
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
-    if ep > 1:
+    if ep > 1 and sp > 1:
+        mesh = make_dp_ep_sp_mesh(dp, ep, sp)
+    elif ep > 1:
         mesh = make_dp_ep_mesh(dp, ep)
     elif sp > 1 and tp > 1:
         mesh = make_dp_sp_tp_mesh(dp, sp, tp)
@@ -170,8 +175,10 @@ def main(argv=None):
     if tp > 1 and sp == 1 and attn == "ring":
         raise SystemExit(
             "--tp with ring attention requires --sp > 1 (3-D mesh)")
-    if ep > 1 and attn == "ring":
-        raise SystemExit("--ep cannot be combined with ring attention")
+    if ep > 1 and attn == "ring" and sp == 1:
+        raise SystemExit(
+            "--ep with ring attention needs --sp > 1 (the 3-D "
+            "gossip × ep × seq mesh)")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -226,9 +233,10 @@ def main(argv=None):
     if ep > 1:
         state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
                                  batch_size=args.batch_size,
-                                 seq_len=args.seq_len, seed=args.seed)
+                                 seq_len=args.seq_len, seed=args.seed,
+                                 sp=sp)
         train_fn = shard_lm_train_step(
-            step, mesh, seq_axis=None,
+            step, mesh, seq_axis=SEQ_AXIS if ring else None,
             state_specs=ep_state_specs(state), ep_axis=EP_AXIS)
     elif tp > 1 and not ring:
         from ..train.lm import init_lm_state_tp
@@ -275,7 +283,12 @@ def main(argv=None):
         for tokens, targets in lm_batches(corpus, dp * ep, sp,
                                           args.batch_size, args.seq_len,
                                           seed=args.seed + epoch):
-            if ep > 1:
+            if ep > 1 and ring:
+                block = args.seq_len // sp
+                tokens = tokens.reshape(dp, ep, sp, args.batch_size, block)
+                targets = targets.reshape(dp, ep, sp, args.batch_size,
+                                          block)
+            elif ep > 1:
                 tokens = tokens.reshape(dp, ep, args.batch_size,
                                         args.seq_len)
                 targets = targets.reshape(dp, ep, args.batch_size,

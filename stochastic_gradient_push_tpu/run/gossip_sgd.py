@@ -381,9 +381,12 @@ def _make_ckpt_manager(args, cfg, world: int, proc_index: int):
 
 
 def _multihost_env() -> bool:
-    """Join a cluster when launched by SLURM with >1 task or when an
-    explicit coordinator is configured (gossip_sgd.py:599-605)."""
+    """Join a cluster when launched by SLURM with >1 task, when an
+    explicit coordinator is configured (gossip_sgd.py:599-605), or on a
+    Cloud TPU pod slice (>1 worker hostname in the VM metadata env)."""
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return True
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
         return True
     try:
         return int(os.environ.get("SLURM_NTASKS", "1")) > 1

@@ -39,6 +39,7 @@ EP_AXIS = "ep"
 
 __all__ = ["SEQ_AXIS", "TP_AXIS", "EP_AXIS", "make_dp_sp_mesh",
            "make_dp_tp_mesh", "make_dp_sp_tp_mesh", "make_dp_ep_mesh",
+           "make_dp_ep_sp_mesh",
            "build_lm_train_step", "shard_lm_train_step", "lm_loss",
            "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
            "init_lm_state_tp", "ep_state_specs", "init_lm_state_ep"]
@@ -80,6 +81,20 @@ def make_dp_ep_mesh(dp: int, ep: int, devices=None) -> Mesh:
     hierarchical local axis) while expert slices stay shard-local.
     """
     return _make_mesh((dp, ep), (GOSSIP_AXIS, EP_AXIS), devices)
+
+
+def make_dp_ep_sp_mesh(dp: int, ep: int, sp: int, devices=None) -> Mesh:
+    """3-D ``(gossip, ep, seq)`` mesh: gossip × expert × ring-sequence
+    parallelism.
+
+    Each (gossip, ep) pair holds its own batch of sequences, sharded into
+    ``sp`` contiguous blocks over ``seq``; every seq shard routes its
+    block's tokens to experts with an all_to_all over ``ep`` (per-block
+    routing, as in MoE × sp), and ring attention runs over ``seq`` within
+    each (gossip, ep) slice.
+    """
+    return _make_mesh((dp, ep, sp), (GOSSIP_AXIS, EP_AXIS, SEQ_AXIS),
+                      devices)
 
 
 def _is_expert_path(path) -> bool:
@@ -298,7 +313,11 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
         manual = {gossip_axis} | ({seq_axis} if seq_axis else set())
         kwargs["axis_names"] = manual
     state_spec = P(gossip_axis) if state_specs is None else state_specs
-    if ep_axis is not None:
+    if ep_axis is not None and seq_axis is not None:
+        # ep × sp: batches shard over (gossip, ep, seq)
+        batch_spec = P(gossip_axis, ep_axis, seq_axis)
+        squeeze_n = 3
+    elif ep_axis is not None:
         # with expert parallelism, token batches shard over (gossip, ep)
         batch_spec = P(gossip_axis, ep_axis)
         squeeze_n = 2
@@ -360,8 +379,9 @@ def init_lm_state(model, mesh, algorithm, tx, dp: int, sp: int,
 
 def init_lm_state_ep(model, mesh, algorithm, tx, dp: int, ep: int,
                      batch_size: int, seq_len: int,
-                     seed: int = 0) -> TrainState:
-    """Initialize expert-parallel LM state on a ``(gossip, ep)`` mesh;
+                     seed: int = 0, sp: int = 1) -> TrainState:
+    """Initialize expert-parallel LM state on a ``(gossip, ep)`` mesh —
+    or ``(gossip, ep, seq)`` with ``sp > 1`` (ep × sp composition);
     pair with ``ep_state_specs(state)`` for the train step's specs.
 
     Parameter init runs under shard_map (the MoE module sizes its local
@@ -374,15 +394,19 @@ def init_lm_state_ep(model, mesh, algorithm, tx, dp: int, ep: int,
 
     from .step import replicate_state
 
+    ring = sp > 1
+    lead = 3 if ring else 2  # leading sharded batch dims to strip
+
     def init_fn(toks):
+        t = toks.reshape(toks.shape[lead:])
         # two init draws: a common key for replicated leaves (identical on
         # every shard → pmean is a no-op that proves ep-invariance) and a
         # shard-folded key so every GLOBAL expert gets an independent draw
-        common = model.init(jax.random.PRNGKey(seed), toks[0, 0])["params"]
+        common = model.init(jax.random.PRNGKey(seed), t)["params"]
         local = model.init(
             jax.random.fold_in(jax.random.PRNGKey(seed),
                                lax.axis_index(EP_AXIS)),
-            toks[0, 0])["params"]
+            t)["params"]
         params = jax.tree_util.tree_map_with_path(
             lambda path, c, l: l if _is_expert_path(path)
             else lax.pmean(c, EP_AXIS),
@@ -390,16 +414,21 @@ def init_lm_state_ep(model, mesh, algorithm, tx, dp: int, ep: int,
         return jax.tree.map(lambda a: a[None], params)
 
     # param STRUCTURE (paths only) via an axis-free probe of the same cfg
-    probe = type(model)(model.cfg._replace(ep_axis=None))
+    probe = type(model)(model.cfg._replace(ep_axis=None, seq_axis=None,
+                                           attn_impl="full"))
     probe_shapes = jax.eval_shape(
         lambda: probe.init(jax.random.PRNGKey(seed),
-                           jnp.zeros((batch_size, seq_len), jnp.int32)))
+                           jnp.zeros((batch_size, seq_len // sp),
+                                     jnp.int32)))
     param_specs = ep_state_specs(probe_shapes["params"])
 
+    in_spec = (P(GOSSIP_AXIS, EP_AXIS, SEQ_AXIS) if ring
+               else P(GOSSIP_AXIS, EP_AXIS))
     sm_init = jax.shard_map(
-        init_fn, mesh=mesh, in_specs=(P(GOSSIP_AXIS, EP_AXIS),),
-        out_specs=param_specs)
-    dummy = np.zeros((dp, ep, batch_size, seq_len), np.int32)
+        init_fn, mesh=mesh, in_specs=(in_spec,), out_specs=param_specs)
+    dummy_shape = ((dp, ep, sp, batch_size, seq_len // sp) if ring
+                   else (dp, ep, batch_size, seq_len))
+    dummy = np.zeros(dummy_shape, np.int32)
 
     def build(d):
         params = sm_init(d)
