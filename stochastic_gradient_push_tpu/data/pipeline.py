@@ -10,7 +10,7 @@ Mirrors the reference's data plumbing (gossip_sgd.py:539-583):
 * :class:`ShardedLoader` — batches every rank's shard and stacks them into
   one global ``(world, per_rank_batch, ...)`` array, the layout the sharded
   train step consumes.  Under multi-host execution each process constructs
-  it with ``ranks=`` (its ``parallel.multihost.owned_ranks``) and gets only
+  it with ``ranks=`` (its ``parallel.multihost.owned_batch_rows``) and gets only
   its local rows, ready for ``jax.make_array_from_process_local_data``.
   ``fast_forward`` reproduces the reference's checkpoint-resume sampler
   spoofing (gossip_sgd.py:356-364) without loading and discarding data.

@@ -14,6 +14,7 @@ from .multihost import (
     global_state_from_local,
     host_local_slice,
     make_global_batch,
+    owned_batch_rows,
     owned_ranks,
     to_host,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "discover",
     "initialize_multihost",
     "owned_ranks",
+    "owned_batch_rows",
     "make_global_batch",
     "to_host",
     "host_local_slice",

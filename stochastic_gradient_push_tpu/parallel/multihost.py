@@ -31,15 +31,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["owned_ranks", "make_global_batch", "to_host",
-           "host_local_slice", "global_state_from_local",
-           "consensus_resume_point", "HIERARCHICAL_IS_SINGLE_PROCESS"]
-
-# single source of truth for the guard raised at both the CLI and the
-# Trainer boundary
-HIERARCHICAL_IS_SINGLE_PROCESS = (
-    "hierarchical (nprocs_per_node) meshes are single-process for now; "
-    "use the flat gossip mesh on pods")
+__all__ = ["owned_ranks", "owned_batch_rows", "make_global_batch",
+           "to_host", "host_local_slice", "global_state_from_local",
+           "consensus_resume_point"]
 
 
 def consensus_resume_point(epoch: int, itr: int) -> tuple[int, int]:
@@ -67,25 +61,47 @@ def owned_ranks(mesh: Mesh, axis: str) -> list[int]:
     """Gossip ranks whose devices belong to this process.
 
     For a 1-D gossip mesh each device is one rank; for a hierarchical
-    ``(node, local)`` mesh the rank is the index along ``axis`` and a rank
-    is owned iff its *first* device is local (ranks never straddle
-    processes on TPU pods: a node's devices share a host).
+    ``(node, local)`` mesh the rank is the index along ``axis``.  A rank
+    must not straddle processes (on TPU pods a node's devices share a
+    host) — verified, not assumed.
     """
     axis_index = mesh.axis_names.index(axis)
     devs = mesh.devices
     # move the rank axis to the front, flatten the rest
     devs = np.moveaxis(devs, axis_index, 0).reshape(devs.shape[axis_index], -1)
     me = jax.process_index()
-    return [int(i) for i in range(devs.shape[0])
-            if devs[i, 0].process_index == me]
+    owned = []
+    for i in range(devs.shape[0]):
+        procs = {d.process_index for d in devs[i]}
+        if len(procs) > 1:
+            raise ValueError(
+                f"rank {i} on axis '{axis}' spans processes {sorted(procs)}"
+                " — a gossip rank's devices must share a host (reshape the"
+                " mesh so node boundaries align with hosts)")
+        if devs[i, 0].process_index == me:
+            owned.append(int(i))
+    return owned
+
+
+def owned_batch_rows(mesh: Mesh) -> list[int]:
+    """Flat batch-row indices this process feeds.
+
+    Batches carry one leading row per *device* in mesh-flat order (the
+    ``P((axes...))`` sharding of the train step); a process feeds the rows
+    of its own devices.  For a 1-D mesh this equals :func:`owned_ranks`.
+    """
+    me = jax.process_index()
+    flat = mesh.devices.reshape(-1)
+    return [int(i) for i, d in enumerate(flat) if d.process_index == me]
 
 
 def make_global_batch(mesh: Mesh, spec: P, local_batch: np.ndarray):
     """Assemble a global device array from this process's batch rows.
 
-    ``local_batch`` carries only the rows for :func:`owned_ranks` (in rank
-    order) along the sharded dimension; single-process meshes pass the full
-    array through unchanged.
+    ``local_batch`` carries one row per local *device* along the sharded
+    dimension — :func:`owned_batch_rows`, in global order (equal to
+    :func:`owned_ranks` on a flat 1-D mesh); single-process meshes pass
+    the full array through unchanged.
     """
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:

@@ -85,6 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--corpus_tokens", default=500_000, type=int)
     p.add_argument("--checkpoint_dir", default="./checkpoints", type=str)
     p.add_argument("--tag", default="lm_", type=str)
+    p.add_argument("--ckpt_every", default=0, type=int,
+                   help="checkpoint every N steps (0 = only at the end)")
+    p.add_argument("--resume", default="False", type=str)
     return p
 
 
@@ -260,19 +263,52 @@ def main(argv=None):
                        jax.tree.map(lambda a: a[0], state.params)))
     log.info(f"mesh {mesh}; {n_params/1e6:.2f}M params; attn={attn}")
 
+    # checkpoint/resume: state and step counter in one atomic msgpack
+    # payload (same manager as the image harness); restored leaves are
+    # device_put back into the live state's shardings
+    from ..utils.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(args.checkpoint_dir, tag=args.tag,
+                             world_size=world)
+    shardings = jax.tree.map(lambda a: a.sharding, state)
+    start_step = 0
+    if sb(args.resume) and ckpt.exists():
+        # the live state is only a structure template; restored host
+        # values are device_put back into its shardings
+        host_state, meta = ckpt.restore(state)
+        state = jax.tree.map(jax.device_put, host_state, shardings)
+        start_step = int(meta.get("step", 0))
+        log.info(f"resumed from step {start_step}")
+    if start_step >= args.num_steps:
+        log.info(f"nothing to do: resumed at step {start_step} >= "
+                 f"num_steps {args.num_steps}")
+        return {"final_loss": None, "avg_loss": None,
+                "tokens_per_sec": 0.0, "already_complete": True}
+
+    def save_ckpt(st, step):
+        ckpt.save(st, {"step": step})
+
     corpus = synthetic_lm_corpus(args.corpus_tokens,
                                  vocab_size=args.vocab_size, seed=args.seed)
     os.makedirs(args.checkpoint_dir, exist_ok=True)
     out_fname = os.path.join(args.checkpoint_dir,
                              f"{args.tag}out_n{world}.csv")
     moe_on = args.moe_experts > 0
-    with open(out_fname, "w") as f:
-        print("step,loss,ppl,lr,tokens_per_sec"
-              + (",moe_dropped" if moe_on else ""), file=f)
+    if not (start_step and os.path.isfile(out_fname)):
+        with open(out_fname, "w") as f:
+            print("step,loss,ppl,lr,tokens_per_sec"
+                  + (",moe_dropped" if moe_on else ""), file=f)
 
     loss_meter = Meter(ptag="Loss")
-    steps_done = 0
-    epoch = 0
+    steps_done = start_step
+    # resume fast-forward: restart the data stream where the saved run
+    # left off instead of replaying consumed batches (≙ the sampler
+    # fast-forward of the image harness, gossip_sgd.py:356-364)
+    n_seqs = (args.corpus_tokens - 1) // args.seq_len
+    batches_per_epoch = max(1, n_seqs // (dp * ep * args.batch_size))
+    epoch = start_step // batches_per_epoch
+    skip_batches = start_step % batches_per_epoch
+    last_saved = start_step - 1
     t0 = time.time()
     tokens_per_step = dp * ep * args.batch_size * args.seq_len
     # XLA CPU in-process collectives require serialized dispatch; on TPU we
@@ -283,6 +319,9 @@ def main(argv=None):
         for tokens, targets in lm_batches(corpus, dp * ep, sp,
                                           args.batch_size, args.seq_len,
                                           seed=args.seed + epoch):
+            if skip_batches:
+                skip_batches -= 1
+                continue
             if ep > 1 and ring:
                 block = args.seq_len // sp
                 tokens = tokens.reshape(dp, ep, sp, args.batch_size, block)
@@ -303,7 +342,8 @@ def main(argv=None):
             if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
                 loss = float(np.mean(np.asarray(metrics["loss"])))
                 loss_meter.update(loss)
-                tps = tokens_per_step * steps_done / (time.time() - t0)
+                tps = (tokens_per_step * (steps_done - start_step)
+                       / (time.time() - t0))
                 row = (f"{steps_done},{loss:.4f},"
                        f"{float(np.mean(np.asarray(metrics['ppl']))):.2f},"
                        f"{float(np.mean(np.asarray(metrics['lr']))):.5f},"
@@ -313,13 +353,18 @@ def main(argv=None):
                         np.mean(np.asarray(metrics['moe_dropped']))))
                 with open(out_fname, "a") as f:
                     print(row, file=f)
+            if args.ckpt_every and steps_done % args.ckpt_every == 0:
+                save_ckpt(state, steps_done)
+                last_saved = steps_done
             if steps_done >= args.num_steps:
                 break
         epoch += 1
+    if last_saved != steps_done:
+        save_ckpt(state, steps_done)
 
     result = {"final_loss": loss_meter.val, "avg_loss": loss_meter.avg,
-              "tokens_per_sec": tokens_per_step * steps_done
-              / (time.time() - t0)}
+              "tokens_per_sec": tokens_per_step
+              * (steps_done - start_step) / (time.time() - t0)}
     log.info(json.dumps(result))
     return result
 

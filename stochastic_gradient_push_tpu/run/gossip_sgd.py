@@ -263,10 +263,6 @@ def main(argv=None, config_transform=None, extra_args=None):
     proc_count = jax.process_count()
     proc_index = jax.process_index()
     if proc_count > 1:
-        if args.nprocs_per_node > 1:
-            from ..parallel.multihost import HIERARCHICAL_IS_SINGLE_PROCESS
-
-            raise SystemExit(HIERARCHICAL_IS_SINGLE_PROCESS)
         if not cfg.checkpoint_all:
             # every process holds *different* ranks; funnelling them into
             # one rank-0 file would interleave writers and corrupt it
@@ -278,11 +274,13 @@ def main(argv=None, config_transform=None, extra_args=None):
                 "--ckpt_backend orbax is single-process for now (orbax "
                 "treats numpy saves as replicated across processes); use "
                 "the msgpack backend on pods")
-        from ..parallel import GOSSIP_AXIS
-        from ..parallel.multihost import owned_ranks
+        from ..parallel.multihost import owned_batch_rows
 
-        local_ranks = owned_ranks(mesh, GOSSIP_AXIS)
-        log.info(f"process {proc_index}/{proc_count}: feeding ranks "
+        # loaders feed one row per local DEVICE (mesh-flat order); the
+        # Trainer separately derives its gossip-rank ownership (node ranks
+        # on a hierarchical mesh)
+        local_ranks = owned_batch_rows(mesh)
+        log.info(f"process {proc_index}/{proc_count}: feeding batch rows "
                  f"{local_ranks}")
     else:
         local_ranks = None

@@ -147,10 +147,10 @@ class Trainer:
         self.proc_count = jax.process_count()
         self.proc_index = jax.process_index()
         if self.proc_count > 1:
-            if self.local_axis is not None:
-                from ..parallel.multihost import (
-                    HIERARCHICAL_IS_SINGLE_PROCESS)
-                raise NotImplementedError(HIERARCHICAL_IS_SINGLE_PROCESS)
+            # works for the flat gossip mesh AND the hierarchical
+            # (node, local) mesh: ranks are indices along the gossip axis
+            # (node ranks when hierarchical), and owned_ranks verifies no
+            # rank straddles hosts
             self.local_ranks = owned_ranks(mesh, self.gossip_axis)
         else:
             self.local_ranks = list(range(self.gossip_world))

@@ -38,7 +38,7 @@ def _worker_env() -> dict:
 
 
 def _launch(port: int, proc_id: int, ckpt_dir: str, epochs: int,
-            resume: str) -> subprocess.Popen:
+            resume: str, extra: tuple = ()) -> subprocess.Popen:
     args = [
         sys.executable, "-m", "stochastic_gradient_push_tpu.run.gossip_sgd",
         "--multihost", "True",
@@ -50,15 +50,17 @@ def _launch(port: int, proc_id: int, ckpt_dir: str, epochs: int,
         "--num_iterations_per_training_epoch", "4",
         "--num_itr_ignore", "0", "--print_freq", "1",
         "--checkpoint_dir", ckpt_dir, "--per_rank_csv", "True",
-        "--resume", resume, "--verbose", "True",
+        "--resume", resume, "--verbose", "True", *extra,
     ]
     return subprocess.Popen(args, cwd=REPO, env=_worker_env(),
                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                             text=True)
 
 
-def _run_pair(port: int, ckpt_dir: str, epochs: int, resume: str) -> list[str]:
-    procs = [_launch(port, i, ckpt_dir, epochs, resume) for i in range(2)]
+def _run_pair(port: int, ckpt_dir: str, epochs: int, resume: str,
+              extra: tuple = ()) -> list[str]:
+    procs = [_launch(port, i, ckpt_dir, epochs, resume, extra)
+             for i in range(2)]
     outs = []
     for p in procs:
         try:
@@ -80,8 +82,8 @@ def test_two_process_train_and_resume(tmp_path):
     outs = _run_pair(port, ckpt_dir, epochs=1, resume="False")
 
     # each process reported its rank ownership
-    assert "feeding ranks [0, 1, 2, 3]" in outs[0]
-    assert "feeding ranks [4, 5, 6, 7]" in outs[1]
+    assert "feeding batch rows [0, 1, 2, 3]" in outs[0]
+    assert "feeding batch rows [4, 5, 6, 7]" in outs[1]
 
     # per-process checkpoints: r0 from process 0, r1 from process 1
     assert os.path.isfile(os.path.join(ckpt_dir, "checkpoint_r0_n8.ckpt"))
@@ -104,3 +106,26 @@ def test_two_process_train_and_resume(tmp_path):
     outs2 = _run_pair(port2, ckpt_dir, epochs=2, resume="True")
     assert any("resumed from epoch 1" in o for o in outs2[:1]), \
         outs2[0][-2000:]
+
+
+@pytest.mark.slow
+def test_two_process_hierarchical_mesh(tmp_path):
+    """Hierarchical (node, local) gossip across 2 processes: exact psum
+    averaging inside each node, gossip between nodes, with node boundaries
+    aligned to hosts (4 nodes x 2 local devices over 2 processes)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    port = _free_port()
+    outs = _run_pair(port, ckpt_dir, epochs=1, resume="False",
+                     extra=("--nprocs_per_node", "2"))
+    # batch rows are device rows; node ranks 0-1 on proc 0, 2-3 on proc 1
+    assert "feeding batch rows [0, 1, 2, 3]" in outs[0]
+    assert "feeding batch rows [4, 5, 6, 7]" in outs[1]
+    # per-rank CSVs are per NODE rank (4 nodes), split across processes
+    for r in range(4):
+        f = os.path.join(ckpt_dir, f"out_r{r}_n8.csv")
+        assert os.path.isfile(f), f"missing node-rank csv {r}"
+        rows = [l for l in open(f).read().splitlines()
+                if l and l[0].isdigit()]
+        assert rows
+    assert os.path.isfile(os.path.join(ckpt_dir, "checkpoint_r0_n8.ckpt"))
+    assert os.path.isfile(os.path.join(ckpt_dir, "checkpoint_r1_n8.ckpt"))

@@ -155,3 +155,26 @@ def test_lm_loss_matches_manual_ce():
     want = -np.mean([logp[b, t, targets[b, t]]
                      for b in range(2) for t in range(8)])
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_lm_cli_checkpoint_and_resume(tmp_path):
+    """LM CLI saves its state+step atomically and resumes from it."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    base = ["--world_size", "8", "--seq_len", "32", "--d_model", "32",
+            "--n_layers", "1", "--n_heads", "4", "--d_ff", "32",
+            "--vocab_size", "32", "--batch_size", "2",
+            "--corpus_tokens", "20000", "--print_freq", "2",
+            "--checkpoint_dir", str(tmp_path)]
+    r1 = main(base + ["--num_steps", "4"])
+    assert np.isfinite(r1["final_loss"])
+    assert (tmp_path / "lm_checkpoint_r0_n8.ckpt").exists()
+
+    r2 = main(base + ["--num_steps", "8", "--resume", "True"])
+    assert np.isfinite(r2["final_loss"])
+    csv = (tmp_path / "lm_out_n8.csv").read_text().splitlines()
+    steps = [int(l.split(",")[0]) for l in csv[1:]]
+    # rows from both runs, continuing past the first run's horizon
+    assert 4 in steps and 8 in steps
