@@ -290,7 +290,8 @@ def replica_spread(state: TrainState, algorithm: GossipAlgorithm) -> dict:
 
     Observability for decentralized training the reference lacks: how far
     apart the rank replicas actually are.  Returns max/mean absolute
-    deviation from the rank-mean over all parameters (host-side numpy on a
+    deviation from the rank-mean over all parameters and the per-rank-
+    averaged L2 norm of the disagreement (host-side numpy on a
     world-stacked state).
     """
     z = jax.vmap(algorithm.eval_params)(state.params, state.gossip)
@@ -300,4 +301,5 @@ def replica_spread(state: TrainState, algorithm: GossipAlgorithm) -> dict:
     dev = np.abs(flat - flat.mean(axis=0, keepdims=True))
     return {"max_spread": float(dev.max()),
             "mean_spread": float(dev.mean()),
+            "spread_l2": float(np.linalg.norm(dev) / np.sqrt(world)),
             "param_scale": float(np.abs(flat).max())}

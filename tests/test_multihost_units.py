@@ -1,0 +1,61 @@
+"""Unit tests for the multi-host helpers (single-process semantics and
+mesh-geometry logic; the cross-process paths are covered by
+tests/test_multihost.py's subprocess integration tests)."""
+
+import jax
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.parallel import (
+    consensus_resume_point,
+    make_global_batch,
+    make_gossip_mesh,
+    make_hierarchical_mesh,
+    owned_batch_rows,
+    owned_ranks,
+    to_host,
+)
+from stochastic_gradient_push_tpu.parallel.mesh import GOSSIP_AXIS, NODE_AXIS
+
+
+def test_single_process_owns_everything():
+    mesh = make_gossip_mesh(8)
+    assert owned_ranks(mesh, GOSSIP_AXIS) == list(range(8))
+    assert owned_batch_rows(mesh) == list(range(8))
+
+
+def test_hierarchical_ranks_are_node_indices():
+    mesh = make_hierarchical_mesh(2, 8)      # (node=4, local=2)
+    assert owned_ranks(mesh, NODE_AXIS) == [0, 1, 2, 3]
+    # batch rows are per-device (8), ranks are per-node (4)
+    assert owned_batch_rows(mesh) == list(range(8))
+
+
+def test_owned_ranks_rejects_straddling_ranks():
+    """A node whose devices belong to different processes must be caught,
+    not silently mis-fed."""
+
+    class FakeDev:
+        def __init__(self, pi):
+            self.process_index = pi
+
+    mesh = make_hierarchical_mesh(2, 8)
+    fake = np.array([[FakeDev(0), FakeDev(1)]] * 4, dtype=object)
+
+    class FakeMesh:
+        axis_names = mesh.axis_names
+        devices = fake
+
+    with pytest.raises(ValueError, match="spans processes"):
+        owned_ranks(FakeMesh(), NODE_AXIS)
+
+
+def test_single_process_passthroughs():
+    mesh = make_gossip_mesh(8)
+    x = np.arange(16.0).reshape(8, 2)
+    from jax.sharding import PartitionSpec as P
+
+    assert make_global_batch(mesh, P(GOSSIP_AXIS), x) is x
+    out = to_host({"a": x}, mesh)
+    np.testing.assert_array_equal(out["a"], x)
+    assert consensus_resume_point(3, 7) == (3, 7)

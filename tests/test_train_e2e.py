@@ -171,14 +171,20 @@ def test_early_exit_iteration_cap(mesh, data):
     assert int(np.asarray(state.step)[0]) == cap
 
 
-def test_scanned_steps_equal_sequential_steps(mesh, data):
-    """k scanned steps == k sequential dispatches, bit-for-bit-ish."""
+@pytest.mark.parametrize("make_alg, staleness", [
+    (lambda s: sgp(s, GOSSIP_AXIS), 0),
+    (lambda s: sgp(s, GOSSIP_AXIS, overlap=True, staleness=2), 2),
+])
+def test_scanned_steps_equal_sequential_steps(mesh, data, make_alg,
+                                              staleness):
+    """k scanned steps == k sequential dispatches, bit-for-bit-ish —
+    for sync SGP and for stale-overlap OSGP (whose in-flight FIFO, a
+    tuple of slots, must thread correctly through the lax.scan carry)."""
     from stochastic_gradient_push_tpu.train import shard_scanned_train_step
 
     images, labels = data
     k = 4
-    model, alg, sharded, state_a, step = build_everything(
-        lambda s: sgp(s, GOSSIP_AXIS), mesh)
+    model, alg, sharded, state_a, step = build_everything(make_alg, mesh)
     state_b = jax.tree.map(jnp.copy, state_a)
 
     sampler = DistributedSampler(len(images), WORLD)
@@ -201,8 +207,14 @@ def test_scanned_steps_equal_sequential_steps(mesh, data):
     jax.block_until_ready(state_b)
 
     assert np.asarray(metrics["loss"]).shape == (WORLD, k)
-    for a, b in zip(jax.tree.leaves(state_a.params),
-                    jax.tree.leaves(state_b.params)):
+    for a, b in zip(jax.tree.leaves(state_a), jax.tree.leaves(state_b),
+                    strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
     assert int(np.asarray(state_b.step)[0]) == k
+    if staleness:
+        # both FIFO slots present and the newest one is non-empty
+        assert len(state_b.gossip.in_flight) == staleness
+        newest = np.asarray(
+            jax.tree.leaves(state_b.gossip.in_flight[-1][0])[0])
+        assert np.abs(newest).max() > 0
