@@ -11,6 +11,7 @@ from .resnet import (
 )
 from .moe import moe_capacity, switch_moe_ffn
 from .small import TinyCNN, TinyMLP
+from .pipeline import PipelineStageLM
 from .transformer import TransformerConfig, TransformerLM
 
 __all__ = [
@@ -23,6 +24,7 @@ __all__ = [
     "resnet152",
     "TinyCNN",
     "TinyMLP",
+    "PipelineStageLM",
     "TransformerLM",
     "TransformerConfig",
     "switch_moe_ffn",

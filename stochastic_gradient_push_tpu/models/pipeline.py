@@ -1,0 +1,104 @@
+"""Pipeline-stage transformer LM: one shard's slice of the layer stack.
+
+Pairs with ``parallel/pipeline.py`` (the tick schedule) and ``train/pp.py``
+(mesh/init/step).  Each pipe shard holds
+
+* ``embed`` / ``ln_f`` / ``lm_head`` — replicated over the pipe axis; only
+  stage 0 (embed) and the last stage (head) produce live outputs, and their
+  gradients are shared with a ``psum`` in the train step;
+* ``stack`` — ``n_local_layers`` transformer blocks stacked on a leading
+  axis (``nn.scan``), *stage-local*: shard ``s`` holds layers
+  ``[s·L/S, (s+1)·L/S)``.  Globally the stacked leaf is sharded over the
+  pipe axis, so a gathered checkpoint holds the full ``L``-layer model.
+
+The block itself is the shared ``_Block`` from models/transformer.py —
+pipeline parallelism changes the layout, not the math.  MoE and ring
+attention are fenced (composition matrix, ARCHITECTURE.md): the pipe loop
+moves *activations* between shards, while MoE/ring move *tokens/KV* inside
+a layer — composing them would nest manual collectives over different axes
+inside the scanned tick body; per-block routing over ep inside a stage is
+the planned extension.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, _Block
+
+__all__ = ["PipelineStageLM"]
+
+
+class _ScanBlock(nn.Module):
+    """Carry-style wrapper so ``nn.scan`` stacks block params on axis 0."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return _Block(self.cfg, name="block")(x, positions), None
+
+
+class PipelineStageLM(nn.Module):
+    """One pipeline stage of a decoder-only LM.
+
+    ``n_local_layers`` is ``cfg.n_layers // n_stages`` — the model object
+    never references the mesh; stage identity comes entirely from which
+    parameter values the shard holds (train/pp.py initializes each shard's
+    stack with a pipe-index-folded RNG).
+    """
+
+    cfg: TransformerConfig
+    n_local_layers: int
+
+    def setup(self):
+        cfg = self.cfg
+        if cfg.moe_experts > 0:
+            raise ValueError("MoE × pipeline is fenced — see ARCHITECTURE.md"
+                             " composition matrix")
+        if cfg.attn_impl == "ring" or cfg.seq_axis is not None:
+            raise ValueError("ring attention × pipeline is fenced — see "
+                             "ARCHITECTURE.md composition matrix")
+        self.embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                              embedding_init=nn.initializers.normal(0.02),
+                              dtype=cfg.dtype)
+        target = _ScanBlock
+        if cfg.remat:
+            target = nn.remat(target, prevent_cse=False)
+        self.stack = nn.scan(
+            target,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=self.n_local_layers)(cfg)
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32)
+        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                dtype=cfg.dtype)
+
+    def embed_tokens(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        """``[..., T] -> [..., T, D]`` — applied to all microbatches."""
+        return self.embed(tokens)
+
+    def blocks(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        """This stage's slice of the layer stack (the pipeline tick body)."""
+        x, _ = self.stack(x, positions)
+        return x
+
+    def head(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Final LN + logits in fp32."""
+        return jnp.asarray(self.lm_head(self.ln_f(x)), jnp.float32)
+
+    def __call__(self, tokens: jnp.ndarray, train: bool = True):
+        """Init/reference path: embed → local stack → head.
+
+        This is NOT the pipelined forward (that lives in train/pp.py —
+        it interleaves ``blocks`` with ``ppermute``); calling it exercises
+        every parameter group once so ``init`` builds the full tree.
+        """
+        del train
+        tokens = tokens.reshape(-1, tokens.shape[-1])  # merge microbatch dims
+        positions = jnp.arange(tokens.shape[-1])
+        x = self.embed_tokens(tokens)
+        x = self.blocks(x, positions)
+        return self.head(x)

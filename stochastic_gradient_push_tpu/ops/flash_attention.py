@@ -41,11 +41,17 @@ __all__ = ["flash_attention", "flash_attention_forward",
 
 NEG_INF = -1e30
 
+# Mosaic requires the last two block dims be (8·k, 128·k) or full-size; a
+# per-row scalar like the logsumexp therefore rides in a [rows, LANES]
+# layout with the value broadcast across the 128 lanes (the same trick the
+# reference TPU kernels use).  Lane 0 is read back at the boundary.
+LANES = 128
+
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                   block_k: int, seq_len: int, causal: bool):
     """One (batch·head, q-block) cell.  Refs: q [block_q, d];
-    k/v [seq, d]; o [block_q, d]; lse [block_q]."""
+    k/v [seq, d]; o [block_q, d]; lse [block_q, LANES]."""
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     q = q_ref[:].astype(jnp.float32) * (d ** -0.5)
@@ -88,8 +94,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         upper = num_k_blocks
     m, den, acc = jax.lax.fori_loop(0, upper, body, (m, den, acc))
     o_ref[:] = (acc / den[:, None]).astype(o_ref.dtype)
-    # per-row logsumexp of the scaled scores — the backward's residual
-    lse_ref[:] = m + jnp.log(den)
+    # per-row logsumexp of the scaled scores — the backward's residual —
+    # broadcast across the lane dim (see LANES)
+    lse_ref[:] = jnp.broadcast_to((m + jnp.log(den))[:, None],
+                                  (block_q, LANES))
 
 
 def flash_attention_forward(q, k, v, causal: bool = False,
@@ -125,17 +133,17 @@ def flash_attention_forward(q, k, v, causal: bool = False,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((None, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
     out = out.reshape(b, h, t, d)
     if return_lse:
-        return out, lse.reshape(b, h, t)
+        return out, lse[..., 0].reshape(b, h, t)
     return out
 
 
@@ -143,14 +151,15 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dq_ref, *, block_q: int, block_k: int, seq_len: int,
                      causal: bool):
     """dQ cell: one (batch·head, q-block); k/v/do stream through.
-    Refs: q/do/dq [block_q, d]; k/v [seq, d]; lse/delta [block_q]."""
+    Refs: q/do/dq [block_q, d]; k/v [seq, d]; lse/delta
+    [block_q, LANES] (lane-broadcast scalars, see LANES)."""
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     scale = d ** -0.5
     q = q_ref[:].astype(jnp.float32) * scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[:][:, 0]
+    delta = delta_ref[:][:, 0]
 
     num_k_blocks = seq_len // block_k
     dq = jnp.zeros((block_q, d), jnp.float32)
@@ -189,7 +198,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, *, block_q: int, block_k: int,
                       seq_len: int, causal: bool):
     """dK/dV cell: one (batch·head, k-block); q/do stream through.
-    Refs: k/v/dk/dv [block_k, d]; q/do [seq, d]; lse/delta [seq]."""
+    Refs: k/v/dk/dv [block_k, d]; q/do [seq, d]; lse/delta
+    [seq, LANES] (lane-broadcast scalars, see LANES)."""
     kj = pl.program_id(1)
     d = k_ref.shape[-1]
     scale = d ** -0.5
@@ -205,8 +215,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(
             jnp.float32) * scale
         do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[pl.ds(qi * block_q, block_q)]
-        delta_blk = delta_ref[pl.ds(qi * block_q, block_q)]
+        lse_blk = lse_ref[pl.ds(qi * block_q, block_q), :][:, 0]
+        delta_blk = delta_ref[pl.ds(qi * block_q, block_q), :][:, 0]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
@@ -258,17 +268,23 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
     dof = do.reshape(b * h, t, d)
-    lsef = lse.reshape(b * h, t)
+    # lane-broadcast the per-row scalars into the [rows, LANES] layout the
+    # kernels require (see LANES)
+    lsef = jnp.broadcast_to(lse.reshape(b * h, t)[..., None],
+                            (b * h, t, LANES))
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(b * h, t)
+    delta = jnp.broadcast_to(delta[..., None], (b * h, t, LANES))
 
     row_specs = [
         pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
         pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # k
         pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # v
         pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # do
-        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),        # lse
-        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),        # δ
+        pl.BlockSpec((None, block_q, LANES),
+                     lambda bh, qi: (bh, qi, 0)),                      # lse
+        pl.BlockSpec((None, block_q, LANES),
+                     lambda bh, qi: (bh, qi, 0)),                      # δ
     ]
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q,
@@ -286,8 +302,8 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
         pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # k
         pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # v
         pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # do
-        pl.BlockSpec((None, t), lambda bh, kj: (bh, 0)),               # lse
-        pl.BlockSpec((None, t), lambda bh, kj: (bh, 0)),               # δ
+        pl.BlockSpec((None, t, LANES), lambda bh, kj: (bh, 0, 0)),     # lse
+        pl.BlockSpec((None, t, LANES), lambda bh, kj: (bh, 0, 0)),     # δ
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q,

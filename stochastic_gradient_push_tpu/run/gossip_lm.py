@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ep", default=1, type=int,
                    help="expert-parallel shards (requires --moe_experts; "
                         "each ep shard also carries its own tokens)")
+    p.add_argument("--pp", default=1, type=int,
+                   help="pipeline stages per replica (GPipe microbatch "
+                        "schedule on a (gossip, pipe) mesh; dense "
+                        "non-ring models only)")
+    p.add_argument("--n_micro", default=4, type=int,
+                   help="microbatches per step when --pp > 1 "
+                        "(must divide batch_size; bubble fraction is "
+                        "(pp-1)/(n_micro+pp-1))")
     p.add_argument("--moe_experts", default=0, type=int,
                    help="total switch-MoE experts (0 = dense FFN)")
     p.add_argument("--moe_every", default=2, type=int)
@@ -121,9 +129,24 @@ def main(argv=None):
     log = make_logger("lm", True)
 
     world = args.world_size or jax.device_count()
-    sp, tp, ep = args.sp, args.tp, args.ep
-    if sp < 1 or tp < 1 or ep < 1:
-        raise SystemExit("--sp, --tp and --ep must be >= 1")
+    sp, tp, ep, pp = args.sp, args.tp, args.ep, args.pp
+    if sp < 1 or tp < 1 or ep < 1 or pp < 1:
+        raise SystemExit("--sp, --tp, --ep and --pp must be >= 1")
+    if pp > 1:
+        # pipeline composes with gossip DP only (ARCHITECTURE.md matrix):
+        # the tick loop moves activations between shards while sp/ep move
+        # tokens/KV inside a layer — nesting them is fenced
+        if sp > 1 or tp > 1 or ep > 1 or args.moe_experts:
+            raise SystemExit("--pp composes with gossip DP only "
+                             "(not --sp/--tp/--ep/--moe_experts)")
+        if args.n_micro < 1:
+            raise SystemExit(f"--n_micro must be >= 1 (got {args.n_micro})")
+        if args.n_layers % pp:
+            raise SystemExit(f"n_layers {args.n_layers} not divisible "
+                             f"by pp {pp}")
+        if args.batch_size % args.n_micro:
+            raise SystemExit(f"batch_size {args.batch_size} not divisible "
+                             f"by n_micro {args.n_micro}")
     if ep > 1 and tp > 1:
         raise SystemExit("--ep does not compose with --tp (expert-slice "
                          "kernels cannot be simultaneously ep-manual and "
@@ -138,14 +161,19 @@ def main(argv=None):
     if args.moe_experts and args.moe_experts % ep:
         raise SystemExit(
             f"moe_experts {args.moe_experts} not divisible by ep {ep}")
-    if world % (sp * tp * ep):
+    if world % (sp * tp * ep * pp):
         raise SystemExit(
-            f"world_size {world} not divisible by sp*tp*ep "
-            f"{sp * tp * ep}")
-    dp = world // (sp * tp * ep)
+            f"world_size {world} not divisible by sp*tp*ep*pp "
+            f"{sp * tp * ep * pp}")
+    dp = world // (sp * tp * ep * pp)
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
-    if ep > 1 and sp > 1:
+    if pp > 1:
+        from ..train.pp import (build_pp_train_step, init_pp_state,
+                                make_dp_pp_mesh, pp_state_specs,
+                                shard_pp_train_step)
+        mesh = make_dp_pp_mesh(dp, pp)
+    elif ep > 1 and sp > 1:
         mesh = make_dp_ep_sp_mesh(dp, ep, sp)
     elif ep > 1:
         mesh = make_dp_ep_mesh(dp, ep)
@@ -182,6 +210,8 @@ def main(argv=None):
         raise SystemExit(
             "--ep with ring attention needs --sp > 1 (the 3-D "
             "gossip × ep × seq mesh)")
+    if pp > 1 and attn == "ring":
+        raise SystemExit("--pp does not compose with ring attention")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -192,7 +222,11 @@ def main(argv=None):
         remat=sb(args.remat),
         moe_experts=args.moe_experts, moe_every=args.moe_every,
         ep_axis=EP_AXIS if ep > 1 else None)
-    model = TransformerLM(cfg)
+    if pp > 1:
+        from ..models import PipelineStageLM
+        model = PipelineStageLM(cfg, n_local_layers=args.n_layers // pp)
+    else:
+        model = TransformerLM(cfg)
 
     if sb(args.all_reduce):
         if args.gossip_every != 1 or args.gossip_comm_dtype:
@@ -227,36 +261,44 @@ def main(argv=None):
     lrs = LRSchedule(ref_lr=args.lr, batch_size=args.batch_size,
                      world_size=dp * ep, decay_schedule={},
                      warmup=sb(args.warmup))
-    step = build_lm_train_step(
-        model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
-        seq_axis=SEQ_AXIS if attn == "ring" else None,
-        ep_axis=EP_AXIS if ep > 1 else None)
-
     ring = attn == "ring"
-    if ep > 1:
-        state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
-                                 batch_size=args.batch_size,
-                                 seq_len=args.seq_len, seed=args.seed,
-                                 sp=sp)
-        train_fn = shard_lm_train_step(
-            step, mesh, seq_axis=SEQ_AXIS if ring else None,
-            state_specs=ep_state_specs(state), ep_axis=EP_AXIS)
-    elif tp > 1 and not ring:
-        from ..train.lm import init_lm_state_tp
-
-        state = init_lm_state_tp(model, mesh, alg, tx, dp=dp,
-                                 batch_size=args.batch_size,
-                                 seq_len=args.seq_len, seed=args.seed)
-        train_fn = shard_lm_train_step(step, mesh, seq_axis=None,
-                                       tp=True)
+    if pp > 1:
+        step = build_pp_train_step(model, alg, tx, lrs,
+                                   itr_per_epoch=itr_per_epoch)
+        state = init_pp_state(model, mesh, alg, tx, dp=dp, pp=pp,
+                              n_micro=args.n_micro,
+                              micro_batch=args.batch_size // args.n_micro,
+                              seq_len=args.seq_len, seed=args.seed)
+        train_fn = shard_pp_train_step(step, mesh, pp_state_specs(state))
     else:
-        state = init_lm_state(
-            model, mesh, alg, tx, dp=dp, sp=sp,
-            batch_size=args.batch_size,
-            block_len=args.seq_len // sp if ring else args.seq_len,
-            seed=args.seed, seq_axis=SEQ_AXIS if ring else None)
-        train_fn = shard_lm_train_step(
-            step, mesh, seq_axis=SEQ_AXIS if ring else None, tp=tp > 1)
+        step = build_lm_train_step(
+            model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
+            seq_axis=SEQ_AXIS if attn == "ring" else None,
+            ep_axis=EP_AXIS if ep > 1 else None)
+        if ep > 1:
+            state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
+                                     batch_size=args.batch_size,
+                                     seq_len=args.seq_len, seed=args.seed,
+                                     sp=sp)
+            train_fn = shard_lm_train_step(
+                step, mesh, seq_axis=SEQ_AXIS if ring else None,
+                state_specs=ep_state_specs(state), ep_axis=EP_AXIS)
+        elif tp > 1 and not ring:
+            from ..train.lm import init_lm_state_tp
+
+            state = init_lm_state_tp(model, mesh, alg, tx, dp=dp,
+                                     batch_size=args.batch_size,
+                                     seq_len=args.seq_len, seed=args.seed)
+            train_fn = shard_lm_train_step(step, mesh, seq_axis=None,
+                                           tp=True)
+        else:
+            state = init_lm_state(
+                model, mesh, alg, tx, dp=dp, sp=sp,
+                batch_size=args.batch_size,
+                block_len=args.seq_len // sp if ring else args.seq_len,
+                seed=args.seed, seq_axis=SEQ_AXIS if ring else None)
+            train_fn = shard_lm_train_step(
+                step, mesh, seq_axis=SEQ_AXIS if ring else None, tp=tp > 1)
 
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree.leaves(
@@ -322,7 +364,13 @@ def main(argv=None):
             if skip_batches:
                 skip_batches -= 1
                 continue
-            if ep > 1 and ring:
+            if pp > 1:
+                micro_b = args.batch_size // args.n_micro
+                tokens = tokens.reshape(dp, args.n_micro, micro_b,
+                                        args.seq_len)
+                targets = targets.reshape(dp, args.n_micro, micro_b,
+                                          args.seq_len)
+            elif ep > 1 and ring:
                 block = args.seq_len // sp
                 tokens = tokens.reshape(dp, ep, sp, args.batch_size, block)
                 targets = targets.reshape(dp, ep, sp, args.batch_size,

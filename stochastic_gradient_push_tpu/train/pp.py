@@ -1,0 +1,211 @@
+"""Pipeline-parallel LM training: gossip data parallelism × GPipe stages
+on one ``(gossip, pipe)`` mesh.
+
+Layout (mirrors the ep composition in train/lm.py):
+
+* mesh ``(gossip, pipe)`` — dp model replicas gossip over the first axis
+  exactly as everywhere else; each replica's *layer stack* is sharded over
+  the second axis (stage ``s`` holds layers ``[s·L/S, (s+1)·L/S)``).
+* state — stack leaves shard ``(gossip, pipe)`` on their leading dims, so
+  the global checkpoint holds the full ``L``-layer model; embed/head/ln_f
+  replicate over pipe with ``P(gossip)``.
+* batches — ``[dp, M, b, t]`` microbatch stacks with spec ``P(gossip)``:
+  every pipe shard of a replica sees the same tokens (stage 0 consumes
+  them, the last stage consumes the targets; the rest are dead operands).
+
+Gradient flow: the loss is computed on every shard but masked to the last
+stage and ``psum``-shared over pipe; autodiff routes cotangents backward
+through the tick schedule's ``ppermute`` chain, landing embed gradients on
+stage 0 and head gradients on the last stage — a second ``psum`` over pipe
+re-replicates those shared leaves, while stack gradients stay stage-local.
+The decentralized algorithms then operate over the gossip axis per-leaf,
+exactly as with ep (stage-local values gossip with their counterparts on
+other replicas).
+
+The reference has no pipeline parallelism (SURVEY.md §2); this extension
+exists so the framework covers every major parallelism axis TPU-first.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..algorithms.api import GossipAlgorithm
+from ..parallel.collectives import as_scalar
+from ..parallel.mesh import GOSSIP_AXIS
+from ..parallel.pipeline import pipeline_spmd
+from .lm import _make_mesh, lm_loss
+from .state import TrainState
+
+PIPE_AXIS = "pipe"
+
+__all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "pp_state_specs",
+           "init_pp_state", "pipeline_forward", "build_pp_train_step",
+           "shard_pp_train_step"]
+
+
+def make_dp_pp_mesh(dp: int, pp: int, devices=None):
+    """2-D ``(gossip, pipe)`` mesh: dp gossip replicas × pp pipeline
+    stages inside each replica."""
+    return _make_mesh((dp, pp), (GOSSIP_AXIS, PIPE_AXIS), devices)
+
+
+def _is_stage_path(path) -> bool:
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    return any(n == "stack" for n in names)
+
+
+def pp_state_specs(state, gossip_axis: str = GOSSIP_AXIS,
+                   pipe_axis: str = PIPE_AXIS):
+    """Per-leaf PartitionSpecs for a pipeline-parallel LM state: stage
+    stack leaves (params and their optimizer mirrors) shard
+    ``(gossip, pipe)``, everything else replicates over pipe with
+    ``P(gossip)``.  Works on arrays or avals."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (P(gossip_axis, pipe_axis)
+                            if _is_stage_path(path) else P(gossip_axis)),
+        state)
+
+
+def pipeline_forward(model, params, tokens: jnp.ndarray,
+                     pipe_axis: str = PIPE_AXIS) -> jnp.ndarray:
+    """Pipelined forward: ``[M, b, t]`` tokens → ``[M, b, t, V]`` logits
+    (valid on the last stage only — mask-and-psum before use)."""
+    positions = jnp.arange(tokens.shape[-1])
+    x = model.apply({"params": params}, tokens, method="embed_tokens")
+
+    def body(h):
+        return model.apply({"params": params}, h, positions,
+                           method="blocks")
+
+    out = pipeline_spmd(body, x, pipe_axis)
+    return model.apply({"params": params}, out, method="head")
+
+
+def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
+                        itr_per_epoch: int,
+                        pipe_axis: str = PIPE_AXIS) -> tp.Callable:
+    """Per-rank pipelined LM step ``(state, tokens, targets) ->
+    (state, metrics)``; same four-slot algorithm structure as every other
+    step builder (train/step.py)."""
+
+    def train_step(state: TrainState, tokens, targets):
+        params, gstate = algorithm.pre_step(state.params, state.gossip)
+        z = algorithm.eval_params(params, gstate)
+        S = lax.axis_size(pipe_axis)
+        stage = lax.axis_index(pipe_axis)
+
+        def loss_fn(p):
+            logits = pipeline_forward(model, p, tokens, pipe_axis)
+            ce = lm_loss(logits, targets)
+            # only the last stage's logits are live.  Return the MASKED
+            # per-shard value (summed over shards it equals the true loss):
+            # a psum here would transpose into a second psum and scale
+            # every gradient by the stage count
+            return jnp.where(stage == S - 1, ce, 0.0)
+
+        masked_loss, grads = jax.value_and_grad(loss_fn)(z)
+        # share the scalar for metrics only, after differentiation
+        loss = lax.psum(masked_loss, pipe_axis)
+        # no manual grad psum over pipe: replicated leaves (embed/head/ln_f)
+        # are device-INVARIANT over pipe, so autodiff transposes their
+        # implicit pvary into a psum — their grads arrive already summed
+        # across stages and replicated; stack grads are stage-local.
+        # (test_pipeline.py::test_grads_match_stacked_model pins this.)
+        grads = algorithm.reduce_grads(grads)
+
+        step = as_scalar(state.step)
+        lr = lr_schedule(step // itr_per_epoch, step % itr_per_epoch,
+                         itr_per_epoch)
+        updates, opt_state = tx.update(grads, state.opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: p - lr.astype(p.dtype) * u, params, updates)
+        params, gstate = algorithm.post_step(params, gstate)
+
+        metrics = {"loss": loss, "ppl": jnp.exp(loss), "lr": lr}
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state, gossip=gstate), metrics
+
+    return train_step
+
+
+def shard_pp_train_step(step_fn, mesh, state_specs,
+                        gossip_axis: str = GOSSIP_AXIS):
+    """Wrap for the ``(gossip, pipe)`` mesh: state per ``state_specs``
+    (see :func:`pp_state_specs`); batches ``[dp, M, b, t]`` with
+    ``P(gossip)`` — replicated over pipe."""
+    batch_spec = P(gossip_axis)
+
+    def wrapped(state, tokens, targets):
+        sq_state = jax.tree.map(lambda a: a[0], state)
+        new_state, metrics = step_fn(sq_state, tokens[0], targets[0])
+        return (jax.tree.map(lambda a: a[None], new_state),
+                jax.tree.map(lambda a: a[None], metrics))
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec),
+        out_specs=(state_specs, P(gossip_axis)))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
+                  n_micro: int, micro_batch: int, seq_len: int,
+                  seed: int = 0) -> TrainState:
+    """Initialize pipeline-parallel LM state on a ``(gossip, pipe)`` mesh.
+
+    Parameter init runs under shard_map: every pipe shard draws its own
+    stack slice with a pipe-index-folded RNG (so all ``L`` global layers
+    get independent draws), while replicated leaves use a common key and a
+    no-op ``pmean`` proves their pipe-invariance.  The whole state
+    materializes straight into its per-leaf shardings — no full-model
+    replica ever exists on one device.
+    """
+    from jax.sharding import NamedSharding
+
+    from .step import replicate_state
+
+    def init_fn(toks):
+        t = toks[0]  # strip gossip lead → [M, b, seq]
+        common = model.init(jax.random.PRNGKey(seed), t)["params"]
+        local = model.init(
+            jax.random.fold_in(jax.random.PRNGKey(seed),
+                               lax.axis_index(PIPE_AXIS)),
+            t)["params"]
+        params = jax.tree_util.tree_map_with_path(
+            lambda path, c, l: l if _is_stage_path(path)
+            else lax.pmean(c, PIPE_AXIS),
+            common, local)
+        return jax.tree.map(lambda a: a[None], params)
+
+    probe = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(seed),
+                           jnp.zeros((n_micro, micro_batch, seq_len),
+                                     jnp.int32)))
+    param_specs = pp_state_specs(probe["params"])
+
+    sm_init = jax.shard_map(init_fn, mesh=mesh,
+                            in_specs=(P(GOSSIP_AXIS),),
+                            out_specs=param_specs)
+    dummy = np.zeros((dp, n_micro, micro_batch, seq_len), np.int32)
+
+    def build(d):
+        params = sm_init(d)
+        one = lambda t: jax.tree.map(lambda a: a[0], t)
+        return TrainState(
+            step=jnp.zeros((dp,), jnp.int32), params=params,
+            batch_stats={},
+            opt_state=replicate_state(tx.init(one(params)), dp),
+            gossip=replicate_state(algorithm.init(one(params)), dp))
+
+    shapes = jax.eval_shape(build, dummy)
+    specs = pp_state_specs(shapes)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(build, out_shardings=shardings)(dummy)
