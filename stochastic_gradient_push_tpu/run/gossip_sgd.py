@@ -269,11 +269,6 @@ def main(argv=None, config_transform=None, extra_args=None):
             raise SystemExit(
                 "--checkpoint_all False is single-process only: on a pod "
                 "each process must write its own checkpoint file")
-        if getattr(args, "ckpt_backend", "msgpack") == "orbax":
-            raise SystemExit(
-                "--ckpt_backend orbax is single-process for now (orbax "
-                "treats numpy saves as replicated across processes); use "
-                "the msgpack backend on pods")
         from ..parallel.multihost import owned_batch_rows
 
         # loaders feed one row per local DEVICE (mesh-flat order); the

@@ -408,8 +408,15 @@ class Trainer:
                         "data_meter": data_meter.state_dict(),
                     }
                     epoch_id = (None if cfg.overwrite_checkpoints else epoch)
+                    # global-state backends (orbax on a pod) take the live
+                    # sharded arrays — every process writes its own shards
+                    # of one logical checkpoint; host-local backends
+                    # (msgpack) take this process's rank rows
                     save_state = (host_local_slice(state)
-                                  if self.proc_count > 1 else state)
+                                  if self.proc_count > 1 and not getattr(
+                                      self.cluster.ckpt,
+                                      "saves_global_state", False)
+                                  else state)
                     self.cluster.save_checkpoint(
                         save_state, meta, epoch_id=epoch_id, is_best=is_best,
                         requeue_on_signal=(epoch != cfg.num_epochs - 1))
@@ -427,9 +434,11 @@ class Trainer:
                        "batch_meter": batch_meter}
 
     def _restore(self, state):
-        """Checkpoint restore; multi-host restores this process's rank rows
-        from its own file and reassembles the global state."""
-        if self.proc_count == 1:
+        """Checkpoint restore; multi-host either restores the global
+        sharded arrays directly (global-state backends, e.g. orbax) or
+        reassembles them from this process's own rank-row file (msgpack)."""
+        if self.proc_count == 1 or getattr(
+                self.cluster.ckpt, "saves_global_state", False):
             return self.cluster.ckpt.restore(state)
         local_tmpl = host_local_slice(state)
         local_state, meta = self.cluster.ckpt.restore(local_tmpl)

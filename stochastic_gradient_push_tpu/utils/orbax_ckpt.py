@@ -2,15 +2,27 @@
 
 The msgpack :class:`~.checkpoint.CheckpointManager` is simple and
 self-contained; this backend adds what big TPU jobs need — asynchronous
-saves that overlap training, automatic retention/GC of old steps, and
-multi-host coordination (every host writes its shard of the world-stacked
-state through the same manager).  Same surface as the msgpack manager so
+saves that overlap training (single-process), automatic retention/GC of
+old steps, and **jax.Array-native multi-host saves**: on a pod every
+process participates in ONE logical checkpoint under one shared root,
+writing only the shards of the global arrays it addresses (orbax's native
+multi-controller flow).  Same surface as the msgpack manager so
 :class:`~.checkpoint.ClusterManager` composes with either.
+
+Why global-array mode rather than per-process numpy files (the msgpack
+layout): orbax's numpy/scalar type handlers hard-code
+``process_index() == 0`` as the only writer — host-local numpy trees from
+other processes silently save empty checkpoints, and no
+``MultiprocessingOptions`` combination reaches that gate.  Global
+``jax.Array`` leaves are the layout orbax is built for; each process
+serializes its own shards and the primary merges/finalizes.  Proven by
+tests/test_multihost.py::test_two_process_orbax_checkpointing.
 
 Reference correspondence: per-epoch ``torch.save`` checkpoints with
 per-rank files and best-model copies (cluster_manager.py:86-118,
 gossip_sgd.py:306-315).  Here epochs map to orbax steps with ``best`` as a
-retained named checkpoint.
+retained named checkpoint; the "per-rank" aspect lives inside the sharded
+global arrays (rank rows) instead of separate files.
 """
 
 from __future__ import annotations
@@ -25,7 +37,16 @@ __all__ = ["OrbaxCheckpointManager"]
 
 
 class OrbaxCheckpointManager:
-    """Orbax ``CheckpointManager`` wrapper with the msgpack manager's API."""
+    """Orbax ``CheckpointManager`` wrapper with the msgpack manager's API.
+
+    Single-process: per-rank root (``{tag}orbax_r{rank}_n{world}``), host
+    numpy trees, async saves.  Multi-process: one shared root
+    (``{tag}orbax_global_n{world}``), global ``jax.Array`` state saved
+    shard-wise by every process (``saves_global_state`` is True — callers
+    must pass the live sharded state, not a host-local slice), synchronous
+    saves (an async commit racing interpreter shutdown can cost one
+    process its checkpoint and desynchronize the cluster on resume).
+    """
 
     def __init__(self, directory: str, tag: str = "", rank: int = 0,
                  world_size: int = 1, all_workers: bool = True,
@@ -37,9 +58,14 @@ class OrbaxCheckpointManager:
         self.tag = tag
         self.rank = rank if all_workers else 0
         self.world_size = world_size
-        root = os.path.join(
-            self.directory, f"{tag}orbax_r{self.rank}_n{world_size}")
-        os.makedirs(root, exist_ok=True)
+        self._multi = jax.process_count() > 1
+        if self._multi:
+            root = os.path.join(
+                self.directory, f"{tag}orbax_global_n{world_size}")
+            async_save = False
+        else:
+            root = os.path.join(
+                self.directory, f"{tag}orbax_r{self.rank}_n{world_size}")
         self._manager = ocp.CheckpointManager(
             root,
             options=ocp.CheckpointManagerOptions(
@@ -56,18 +82,39 @@ class OrbaxCheckpointManager:
         )
         self.checkpoint_path = root  # for parity with the msgpack manager
 
+    @property
+    def saves_global_state(self) -> bool:
+        """True when callers must save/restore the live globally-sharded
+        state (multi-process) instead of host-local values."""
+        return self._multi
+
     # -- msgpack-manager-compatible surface --------------------------------
 
     def path_for_epoch(self, epoch_id: int | None) -> str:
         step = 0 if epoch_id is None else epoch_id
         return os.path.join(self.checkpoint_path, str(step))
 
+    def _to_savable(self, state):
+        if self._multi:
+            return state  # live jax.Arrays: each process writes its shards
+        return jax.tree.map(np.asarray, state)
+
+    def _template(self, state_template):
+        if self._multi:
+            # abstract arrays carrying shardings: orbax reassembles each
+            # process's shards into global jax.Arrays on restore
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=a.sharding)
+                if isinstance(a, jax.Array) else np.asarray(a),
+                state_template)
+        return jax.tree.map(np.asarray, state_template)
+
     def save(self, state, meta: dict, epoch_id: int | None = None,
              is_best: bool = False) -> str:
         step = int(meta.get("epoch", 0)) if epoch_id is None else epoch_id
         args = self._ocp.args.Composite(
-            state=self._ocp.args.StandardSave(jax.tree.map(np.asarray,
-                                                           state)),
+            state=self._ocp.args.StandardSave(self._to_savable(state)),
             meta=self._ocp.args.JsonSave(dict(meta, is_best=bool(is_best))),
         )
         self._manager.save(step, args=args)
@@ -78,37 +125,28 @@ class OrbaxCheckpointManager:
     def exists(self) -> bool:
         return self._manager.latest_step() is not None
 
-    def restore(self, state_template) -> tuple[tp.Any, dict]:
-        step = self._manager.latest_step()
+    def _restore_from(self, manager, state_template):
+        step = manager.latest_step()
         if step is None:
             raise FileNotFoundError(
-                f"no orbax checkpoint under {self.checkpoint_path}")
-        template = jax.tree.map(np.asarray, state_template)
-        restored = self._manager.restore(
+                f"no orbax checkpoint under {manager.directory}")
+        restored = manager.restore(
             step,
             args=self._ocp.args.Composite(
-                state=self._ocp.args.StandardRestore(template),
+                state=self._ocp.args.StandardRestore(
+                    self._template(state_template)),
                 meta=self._ocp.args.JsonRestore(),
             ))
         meta = dict(restored["meta"] or {})
         meta.pop("is_best", None)
         return restored["state"], meta
 
+    def restore(self, state_template) -> tuple[tp.Any, dict]:
+        return self._restore_from(self._manager, state_template)
+
     def restore_best(self, state_template) -> tuple[tp.Any, dict]:
         """Restore the best-so-far checkpoint (≙ model_best files)."""
-        step = self._best_manager.latest_step()
-        if step is None:
-            raise FileNotFoundError("no best checkpoint recorded")
-        template = jax.tree.map(np.asarray, state_template)
-        restored = self._best_manager.restore(
-            step,
-            args=self._ocp.args.Composite(
-                state=self._ocp.args.StandardRestore(template),
-                meta=self._ocp.args.JsonRestore(),
-            ))
-        meta = dict(restored["meta"] or {})
-        meta.pop("is_best", None)
-        return restored["state"], meta
+        return self._restore_from(self._best_manager, state_template)
 
     def wait(self) -> None:
         """Block until in-flight async saves land (call before exit)."""
