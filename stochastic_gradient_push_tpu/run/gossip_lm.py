@@ -96,6 +96,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_every", default=0, type=int,
                    help="checkpoint every N steps (0 = only at the end)")
     p.add_argument("--resume", default="False", type=str)
+    # multi-host (same surface as gossip_sgd)
+    p.add_argument("--multihost", default="auto",
+                   choices=["auto", "True", "False"],
+                   help="True/False/auto: join a jax.distributed cluster "
+                        "(auto = when SLURM/coordinator env vars are "
+                        "present or on a TPU pod slice)")
+    p.add_argument("--coordinator_address", default=None, type=str)
+    p.add_argument("--num_processes", default=None, type=int)
+    p.add_argument("--process_id", default=None, type=int)
     return p
 
 
@@ -124,9 +133,18 @@ def main(argv=None):
                             shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
     from ..utils import Meter, make_logger
-    from .gossip_sgd import _str_bool as sb
+    from .gossip_sgd import _multihost_env, _str_bool as sb
 
-    log = make_logger("lm", True)
+    want_mh = args.multihost
+    if want_mh == "True" or (want_mh == "auto" and _multihost_env()):
+        from ..parallel.discovery import initialize_multihost
+
+        initialize_multihost(args.coordinator_address, args.num_processes,
+                             args.process_id)
+
+    proc_count = jax.process_count()
+    proc_index = jax.process_index()
+    log = make_logger(f"lm p{proc_index}" if proc_count > 1 else "lm", True)
 
     world = args.world_size or jax.device_count()
     sp, tp, ep, pp = args.sp, args.tp, args.ep, args.pp
@@ -183,6 +201,17 @@ def main(argv=None):
         mesh = make_dp_tp_mesh(dp, tp)
     else:
         mesh = make_dp_sp_mesh(dp, sp)
+
+    if proc_count > 1:
+        # per-process feeding/checkpointing is wired for the dp and dp×sp
+        # meshes; ep/tp/pp shard state on non-leading dims (or via GSPMD),
+        # which the per-process rank-row checkpoint layout cannot slice
+        if ep > 1 or tp > 1 or pp > 1:
+            raise SystemExit("--ep/--tp/--pp with --multihost are not "
+                             "supported yet; use dp or dp×sp meshes on "
+                             "pods")
+        log.info(f"process {proc_index}/{proc_count}: multihost LM over "
+                 f"{mesh}")
 
     def _flash_ok(seq_len: int) -> bool:
         # the pallas kernel needs the (clamped) 128 block to divide seq_len
@@ -307,14 +336,39 @@ def main(argv=None):
 
     # checkpoint/resume: state and step counter in one atomic msgpack
     # payload (same manager as the image harness); restored leaves are
-    # device_put back into the live state's shardings
+    # device_put back into the live state's shardings.  On a pod each
+    # process saves/restores its own rank rows (per-process files), and
+    # the cluster resumes from the minimum step any process holds.
+    from ..parallel.multihost import (consensus_resume_point,
+                                      global_state_from_local,
+                                      host_local_slice, to_host)
     from ..utils.checkpoint import CheckpointManager
 
     ckpt = CheckpointManager(args.checkpoint_dir, tag=args.tag,
-                             world_size=world)
+                             rank=proc_index, world_size=world,
+                             all_workers=proc_count > 1)
     shardings = jax.tree.map(lambda a: a.sharding, state)
     start_step = 0
-    if sb(args.resume) and ckpt.exists():
+    if sb(args.resume) and proc_count > 1:
+        # decide to resume COLLECTIVELY: gating the restore (and its
+        # allgather) on a per-process exists() would hang the cluster when
+        # one process's checkpoint is missing/torn — resume only when
+        # every process holds a file, else all start from step 0
+        from jax.experimental import multihost_utils
+
+        all_have = int(np.min(np.asarray(multihost_utils.process_allgather(
+            np.asarray([int(ckpt.exists())])))))
+        if all_have:
+            local_tmpl = host_local_slice(state)
+            local_state, meta = ckpt.restore(local_tmpl)
+            state = global_state_from_local(mesh, GOSSIP_AXIS, local_state)
+            _, start_step = consensus_resume_point(
+                0, int(meta.get("step", 0)))
+            log.info(f"resumed from step {start_step}")
+        elif ckpt.exists():
+            log.info("checkpoint present here but missing on a peer; "
+                     "starting from step 0")
+    elif sb(args.resume) and ckpt.exists():
         # the live state is only a structure template; restored host
         # values are device_put back into its shardings
         host_state, meta = ckpt.restore(state)
@@ -328,13 +382,16 @@ def main(argv=None):
                 "tokens_per_sec": 0.0, "already_complete": True}
 
     def save_ckpt(st, step):
-        ckpt.save(st, {"step": step})
+        ckpt.save(host_local_slice(st) if proc_count > 1 else st,
+                  {"step": step})
 
     corpus = synthetic_lm_corpus(args.corpus_tokens,
                                  vocab_size=args.vocab_size, seed=args.seed)
     os.makedirs(args.checkpoint_dir, exist_ok=True)
-    out_fname = os.path.join(args.checkpoint_dir,
-                             f"{args.tag}out_n{world}.csv")
+    out_fname = os.path.join(
+        args.checkpoint_dir,
+        f"{args.tag}out_n{world}.csv" if proc_count == 1
+        else f"{args.tag}out_p{proc_index}_n{world}.csv")
     moe_on = args.moe_experts > 0
     if not (start_step and os.path.isfile(out_fname)):
         with open(out_fname, "w") as f:
@@ -357,6 +414,24 @@ def main(argv=None):
     # fetch metrics only at print points so dispatch stays asynchronous
     serialize = jax.default_backend() == "cpu"
     metrics = None
+    if proc_count > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        bspec = P(GOSSIP_AXIS, SEQ_AXIS) if ring else P(GOSSIP_AXIS)
+        bsharding = NamedSharding(mesh, bspec)
+
+        def globalize(arr):
+            # every process materializes the same (seed-deterministic
+            # synthetic) global batch and contributes only the shards its
+            # devices address; a real corpus would shard the stream
+            return jax.make_array_from_callback(
+                arr.shape, bsharding, lambda idx: arr[idx])
+    else:
+        globalize = lambda arr: arr
+
+    def host_metrics(m):
+        # sharded metrics are not host-addressable on a pod: all-gather
+        return (to_host(m, mesh) if proc_count > 1
+                else jax.tree.map(np.asarray, m))
     while steps_done < args.num_steps:
         for tokens, targets in lm_batches(corpus, dp * ep, sp,
                                           args.batch_size, args.seq_len,
@@ -383,22 +458,23 @@ def main(argv=None):
             elif attn != "ring":
                 tokens = tokens.reshape(dp, args.batch_size, args.seq_len)
                 targets = targets.reshape(dp, args.batch_size, args.seq_len)
-            state, metrics = train_fn(state, tokens, targets)
+            state, metrics = train_fn(state, globalize(tokens),
+                                      globalize(targets))
             if serialize:
                 jax.block_until_ready(state)
             steps_done += 1
             if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
-                loss = float(np.mean(np.asarray(metrics["loss"])))
+                mh = host_metrics(metrics)
+                loss = float(np.mean(mh["loss"]))
                 loss_meter.update(loss)
                 tps = (tokens_per_step * (steps_done - start_step)
                        / (time.time() - t0))
                 row = (f"{steps_done},{loss:.4f},"
-                       f"{float(np.mean(np.asarray(metrics['ppl']))):.2f},"
-                       f"{float(np.mean(np.asarray(metrics['lr']))):.5f},"
+                       f"{float(np.mean(mh['ppl'])):.2f},"
+                       f"{float(np.mean(mh['lr'])):.5f},"
                        f"{tps:.0f}")
                 if moe_on:
-                    row += (",%.4f" % float(
-                        np.mean(np.asarray(metrics['moe_dropped']))))
+                    row += (",%.4f" % float(np.mean(mh['moe_dropped'])))
                 with open(out_fname, "a") as f:
                     print(row, file=f)
             if args.ckpt_every and steps_done % args.ckpt_every == 0:

@@ -349,8 +349,22 @@ class Trainer:
         start_epoch, start_itr, best_prec1 = 0, 0, 0.0
         elapsed = 0.0
 
-        if cfg.resume and self.cluster is not None \
-                and self.cluster.ckpt.exists():
+        want_resume = cfg.resume and self.cluster is not None
+        have_ckpt = want_resume and self.cluster.ckpt.exists()
+        if want_resume and self.proc_count > 1:
+            # decide COLLECTIVELY: a per-process exists() gate would hang
+            # the cluster when one process's checkpoint is missing/torn
+            # (the survivors enter the restore collectives alone)
+            from jax.experimental import multihost_utils
+
+            all_have = int(np.min(np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([int(have_ckpt)])))))
+            if have_ckpt and not all_have:
+                self.log.info("checkpoint present here but missing on a "
+                              "peer; starting from epoch 0")
+            have_ckpt = bool(all_have)
+        if have_ckpt:
             state, meta = self._restore(state)
             start_epoch = meta.get("epoch", 0)
             start_itr = meta.get("itr", 0)

@@ -1,0 +1,127 @@
+"""Multi-host LM training: 2 processes × 4 CPU devices over
+jax.distributed, through the real gossip_lm CLI.
+
+Extends the image-harness multi-host proof (tests/test_multihost.py) to
+the transformer path: per-process batch contribution via
+``jax.make_array_from_callback``, cross-process ring-attention sequence
+parallelism on a (gossip, seq) mesh, per-process CSVs, and per-process
+checkpoint save + consensus resume.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _launch(port: int, proc_id: int, ckpt_dir: str, num_steps: int,
+            resume: str, extra: tuple = ()) -> subprocess.Popen:
+    args = [
+        sys.executable, "-m", "stochastic_gradient_push_tpu.run.gossip_lm",
+        "--multihost", "True",
+        "--coordinator_address", f"127.0.0.1:{port}",
+        "--num_processes", "2", "--process_id", str(proc_id),
+        "--world_size", "8", "--vocab_size", "64", "--d_model", "32",
+        "--n_layers", "2", "--n_heads", "4", "--d_ff", "64",
+        "--seq_len", "32", "--batch_size", "4",
+        "--num_steps", str(num_steps), "--print_freq", "2",
+        "--checkpoint_dir", ckpt_dir, "--resume", resume, *extra,
+    ]
+    return subprocess.Popen(args, cwd=REPO, env=_worker_env(),
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+
+
+def _run_pair(port: int, ckpt_dir: str, num_steps: int, resume: str,
+              extra: tuple = ()) -> list[str]:
+    procs = [_launch(port, i, ckpt_dir, num_steps, resume, extra)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-4000:]}"
+    return outs
+
+
+def _csv_losses(path):
+    rows = [l for l in open(path).read().splitlines() if l[:1].isdigit()]
+    return [float(r.split(",")[1]) for r in rows]
+
+
+@pytest.mark.slow
+def test_two_process_lm_train_and_resume(tmp_path):
+    """Plain gossip-DP LM across 2 processes: trains, writes per-process
+    CSVs with finite decreasing-ish loss, then resumes from per-process
+    checkpoints at the consensus step."""
+    ckpt_dir = str(tmp_path / "lm")
+    port = _free_port()
+    outs = _run_pair(port, ckpt_dir, num_steps=8, resume="False")
+    for p in range(2):
+        f = os.path.join(ckpt_dir, f"lm_out_p{p}_n8.csv")
+        assert os.path.isfile(f), f"missing per-process csv {f}"
+        losses = _csv_losses(f)
+        assert losses and all(np.isfinite(losses))
+    # the two processes see identical (replicated) metrics
+    assert _csv_losses(os.path.join(ckpt_dir, "lm_out_p0_n8.csv")) == \
+        _csv_losses(os.path.join(ckpt_dir, "lm_out_p1_n8.csv"))
+    for r in (0, 1):
+        assert os.path.isfile(
+            os.path.join(ckpt_dir, f"lm_checkpoint_r{r}_n8.ckpt"))
+
+    port2 = _free_port()
+    outs2 = _run_pair(port2, ckpt_dir, num_steps=12, resume="True")
+    assert all("resumed from step 8" in o for o in outs2), outs2[0][-2000:]
+
+
+@pytest.mark.slow
+def test_two_process_lm_ring_attention(tmp_path):
+    """dp×sp across processes: ring attention's KV rotation crosses the
+    host boundary (4 replicas × 2 sequence shards over 2 processes)."""
+    ckpt_dir = str(tmp_path / "lm_sp")
+    port = _free_port()
+    outs = _run_pair(port, ckpt_dir, num_steps=6, resume="False",
+                     extra=("--sp", "2"))
+    assert all("multihost LM" in o for o in outs)
+    losses = _csv_losses(os.path.join(ckpt_dir, "lm_out_p0_n8.csv"))
+    assert losses and all(np.isfinite(losses))
+
+
+def test_multihost_fences(monkeypatch, tmp_path):
+    """ep/tp/pp on pods are fenced with an actionable error (checked
+    in-process by spoofing the process count — no cluster needed)."""
+    import jax
+
+    from stochastic_gradient_push_tpu.run import gossip_lm
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    with pytest.raises(SystemExit, match="not supported yet"):
+        gossip_lm.main(["--multihost", "False", "--world_size", "8",
+                        "--pp", "2", "--num_steps", "1",
+                        "--checkpoint_dir", str(tmp_path)])

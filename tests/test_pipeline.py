@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from stochastic_gradient_push_tpu.algorithms import all_reduce, sgp
+from stochastic_gradient_push_tpu.algorithms import all_reduce, dpsgd, sgp
 from stochastic_gradient_push_tpu.models import (
     PipelineStageLM, TransformerConfig, TransformerLM)
 from stochastic_gradient_push_tpu.parallel import GOSSIP_AXIS
@@ -138,6 +138,27 @@ class TestPipelineParity:
 
 
 class TestPipelineGossip:
+    @pytest.mark.parametrize("make_alg", [
+        lambda dp: sgp(build_schedule(
+            DynamicDirectedExponentialGraph(dp)), GOSSIP_AXIS,
+            overlap=True),
+        lambda dp: dpsgd(build_schedule(
+            DynamicDirectedExponentialGraph(dp)), GOSSIP_AXIS),
+    ], ids=["osgp", "dpsgd"])
+    def test_other_algorithms_compose_with_pipeline(self, make_alg):
+        """OSGP (overlap, in-flight gossip buffer in the carried state) and
+        D-PSGD both slot into the pipelined step unchanged."""
+        dp, pp, n_layers, n_micro = 4, 2, 2, 2
+        alg = make_alg(dp)
+        _, _, state, train_fn, toks, tgts = _setup(
+            dp, pp, n_layers, n_micro, algorithm=alg, momentum=0.9)
+        rng = np.random.default_rng(2)
+        for _ in range(4):
+            toks = rng.integers(0, VOCAB, size=toks.shape).astype(np.int32)
+            tgts = rng.integers(0, VOCAB, size=tgts.shape).astype(np.int32)
+            state, metrics = train_fn(state, toks, tgts)
+        assert np.all(np.isfinite(np.asarray(metrics["loss"])))
+
     def test_sgp_composes_with_pipeline(self):
         """dp=4 gossip replicas × pp=2 stages: SGP trains, push-sum weight
         stays 1 (regular mixing), and replicas drift toward consensus."""
