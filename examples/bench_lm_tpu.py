@@ -28,9 +28,11 @@ from stochastic_gradient_push_tpu.topology import (
 from stochastic_gradient_push_tpu.train import LRSchedule, sgd
 from stochastic_gradient_push_tpu.train.lm import (build_lm_train_step,
                                                    init_lm_state,
-                                                   shard_lm_train_step)
+                                                   shard_lm_train_step,
+                                                   shard_scanned_lm_step)
 
 STEPS = int(os.environ.get("LMBENCH_STEPS", "20"))
+SCAN = int(os.environ.get("LMBENCH_SCAN", "4"))
 
 # (d_model, n_layers, n_heads, seq_len, batch) — a ~125M GPT-small-shaped
 # config and a long-context variant
@@ -75,13 +77,21 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000):
                                seq_axis=None)
     state = init_lm_state(model, mesh, alg, tx, dp=world, sp=1,
                           batch_size=batch, block_len=seq, seq_axis=None)
-    train_fn = shard_lm_train_step(step, mesh, seq_axis=None)
+    if SCAN > 1:
+        train_fn = shard_scanned_lm_step(step, mesh, n_steps=SCAN,
+                                         seq_axis=None)
+    else:
+        train_fn = shard_lm_train_step(step, mesh, seq_axis=None)
 
     rng = np.random.default_rng(0)
-    toks = rng.integers(0, vocab, size=(world, batch, seq)).astype(np.int32)
-    tgts = rng.integers(0, vocab, size=(world, batch, seq)).astype(np.int32)
+    shape = (world, batch, seq)
+    if SCAN > 1:
+        shape = (SCAN,) + shape
+    toks = rng.integers(0, vocab, size=shape).astype(np.int32)
+    tgts = rng.integers(0, vocab, size=shape).astype(np.int32)
     from jax.sharding import NamedSharding, PartitionSpec as P
-    sh = NamedSharding(mesh, P(GOSSIP_AXIS))
+    spec = P(None, GOSSIP_AXIS) if SCAN > 1 else P(GOSSIP_AXIS)
+    sh = NamedSharding(mesh, spec)
     toks = jax.device_put(toks, sh)
     tgts = jax.device_put(tgts, sh)
 
@@ -105,22 +115,24 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000):
     for _ in range(STEPS):
         state, m = run_fn(state, toks, tgts)
     loss = float(np.min(np.asarray(jax.device_get(m["loss"]))))
-    dt = (time.perf_counter() - t0) / STEPS
+    # one dispatch runs SCAN fused steps; XLA's cost analysis counts the
+    # scan body once, so `flops` is already per-iteration (see bench.py)
+    time_per_itr = (time.perf_counter() - t0) / (STEPS * SCAN)
     assert np.isfinite(loss), "non-finite loss"
 
     n_params = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(
         jax.tree.map(lambda a: a[0], state.params)))
-    tokens_per_sec = world * batch * seq / dt
+    tokens_per_sec = world * batch * seq / time_per_itr
     out = {"config": f"d{d_model} L{n_layers} h{n_heads} t{seq} b{batch}",
-           "params_m": round(n_params / 1e6, 1),
+           "params_m": round(n_params / 1e6, 1), "scan": SCAN,
            "tokens_per_sec_per_chip": round(tokens_per_sec / world),
-           "step_ms": round(dt * 1e3, 2), "loss": round(loss, 3)}
+           "step_ms": round(time_per_itr * 1e3, 2), "loss": round(loss, 3)}
     peak = peak_tflops(jax.devices()[0].device_kind)
     if flops and peak:
-        out["mfu"] = round(flops / dt / (peak * 1e12 * world), 4)
+        out["mfu"] = round(flops / time_per_itr / (peak * 1e12 * world), 4)
         # 6·N·T rule-of-thumb for comparison with the XLA-counted number
         out["mfu_6nd"] = round(
-            6 * n_params * batch * seq / dt / (peak * 1e12), 4)
+            6 * n_params * batch * seq / time_per_itr / (peak * 1e12), 4)
     print(json.dumps(out), flush=True)
 
 

@@ -98,20 +98,26 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
                   jnp.zeros_like(qf),       # numerator
                   zeros_bht)                # denominator
 
-    # own block first, then exactly world-1 rotations: rotate-then-attend
-    # keeps the final iteration free of a dead K/V transfer
-    state = attend(init_state, k, v, my_rank)
-
+    # send-then-attend: each iteration ISSUES the rotation of the block it
+    # holds before attending it.  The ppermute has no data dependency on
+    # the attend, so XLA's async collectives overlap the step-s+1 K/V
+    # transfer with the step-s block attention (the double-buffering the
+    # reference's gossip thread provided by hand, here by dependency
+    # structure).  The last received block is attended outside the scan so
+    # no dead final transfer is emitted.
     def body(carry, step):
         state, k_blk, v_blk = carry
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        nk = lax.ppermute(k_blk, axis_name, perm)
+        nv = lax.ppermute(v_blk, axis_name, perm)
         state = attend(state, k_blk, v_blk, (my_rank - step) % world)
-        return (state, k_blk, v_blk), None
+        return (state, nk, nv), None
 
     if world > 1:
-        (state, _, _), _ = lax.scan(body, (state, k, v),
-                                    jnp.arange(1, world))
+        (state, k_last, v_last), _ = lax.scan(
+            body, (init_state, k, v), jnp.arange(world - 1))
+        state = attend(state, k_last, v_last, (my_rank + 1) % world)
+    else:
+        state = attend(init_state, k, v, my_rank)
     m, num, den = state
     out = num / den[..., None]
     return out.astype(q.dtype)

@@ -40,7 +40,8 @@ EP_AXIS = "ep"
 __all__ = ["SEQ_AXIS", "TP_AXIS", "EP_AXIS", "make_dp_sp_mesh",
            "make_dp_tp_mesh", "make_dp_sp_tp_mesh", "make_dp_ep_mesh",
            "make_dp_ep_sp_mesh",
-           "build_lm_train_step", "shard_lm_train_step", "lm_loss",
+           "build_lm_train_step", "shard_lm_train_step",
+           "shard_scanned_lm_step", "lm_loss",
            "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
            "init_lm_state_tp", "ep_state_specs", "init_lm_state_ep"]
 
@@ -325,6 +326,45 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
         wrapped, mesh=mesh,
         in_specs=(state_spec, batch_spec, batch_spec),
         out_specs=(state_spec, P(gossip_axis)), **kwargs)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_scanned_lm_step(step_fn, mesh, n_steps: int,
+                          gossip_axis: str = GOSSIP_AXIS,
+                          seq_axis: str | None = None):
+    """Fuse ``n_steps`` LM train steps into one compiled program via
+    ``lax.scan`` (the LM counterpart of train/step.py::
+    shard_scanned_train_step — same dispatch-amortization rationale).
+
+    Token batches gain a leading scan dimension:
+    ``tokens[n_steps, dp(, sp), batch, block]``; metrics come back stacked
+    ``[dp, n_steps]``.  Supports the plain dp and dp×sp (ring) layouts.
+    """
+    if seq_axis is None:
+        batch_spec = P(None, gossip_axis)
+        lead = 2
+    else:
+        batch_spec = P(None, gossip_axis, seq_axis)
+        lead = 3
+
+    def wrapped(state, tokens, targets):
+        sq = lambda t: jax.tree.map(
+            lambda a: a.reshape(a.shape[:1] + a.shape[lead:]), t)
+
+        def body(st, batch):
+            toks, tgts = batch
+            return step_fn(st, toks, tgts)
+
+        new_state, metrics = lax.scan(
+            body, jax.tree.map(lambda a: a[0], state),
+            (sq(tokens), sq(targets)))
+        return (jax.tree.map(lambda a: a[None], new_state),
+                jax.tree.map(lambda a: a[None], metrics))
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(gossip_axis), batch_spec, batch_spec),
+        out_specs=(P(gossip_axis), P(gossip_axis)))
     return jax.jit(sharded, donate_argnums=(0,))
 
 

@@ -178,3 +178,59 @@ def test_lm_cli_checkpoint_and_resume(tmp_path):
     steps = [int(l.split(",")[0]) for l in csv[1:]]
     # rows from both runs, continuing past the first run's horizon
     assert 4 in steps and 8 in steps
+
+
+def test_scanned_lm_step_matches_sequential():
+    """shard_scanned_lm_step(n) produces the same state and per-step losses
+    as n individual dispatches, for plain dp and dp x sp (ring) layouts."""
+    from stochastic_gradient_push_tpu.train.lm import (init_lm_state,
+                                                       shard_scanned_lm_step)
+
+    for ring in (False, True):
+        sp = SP if ring else 1
+        mesh = make_dp_sp_mesh(DP, SP) if ring else make_dp_sp_mesh(DP * SP,
+                                                                    1)
+        dp = DP if ring else DP * SP
+        cfg = small_cfg("ring" if ring else "full",
+                        seq_axis=SEQ_AXIS if ring else None)
+        model = TransformerLM(cfg)
+        alg = sgp(build_schedule(DynamicDirectedExponentialGraph(dp)),
+                  GOSSIP_AXIS)
+        tx = sgd(momentum=0.9, weight_decay=0.0)
+        lrs = LRSchedule(ref_lr=0.1, batch_size=BATCH, world_size=dp,
+                         decay_schedule={}, warmup=False)
+        step = build_lm_train_step(
+            model, alg, tx, lrs, itr_per_epoch=100,
+            seq_axis=SEQ_AXIS if ring else None)
+        seq_axis = SEQ_AXIS if ring else None
+        train_fn = shard_lm_train_step(step, mesh, seq_axis=seq_axis)
+        scan_fn = shard_scanned_lm_step(step, mesh, n_steps=3,
+                                        seq_axis=seq_axis)
+        block = SEQ // sp
+
+        state = init_lm_state(model, mesh, alg, tx, dp=dp, sp=sp,
+                              batch_size=BATCH, block_len=block,
+                              seq_axis=seq_axis)
+        state2 = jax.tree.map(jnp.copy, state)
+
+        rng = np.random.default_rng(0)
+        shape = ((dp, sp, BATCH, block) if ring
+                 else (dp, BATCH, block))
+        toks = rng.integers(0, VOCAB, size=(3,) + shape).astype(np.int32)
+        tgts = rng.integers(0, VOCAB, size=(3,) + shape).astype(np.int32)
+
+        seq_losses = []
+        for i in range(3):
+            state, m = train_fn(state, toks[i], tgts[i])
+            jax.block_until_ready(state)
+            seq_losses.append(np.asarray(m["loss"]))
+        state2, ms = scan_fn(state2, toks, tgts)
+        jax.block_until_ready(state2)
+
+        np.testing.assert_allclose(
+            np.stack(seq_losses, axis=1), np.asarray(ms["loss"]),
+            rtol=1e-5, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(state2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=1e-6)
