@@ -96,6 +96,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_every", default=0, type=int,
                    help="checkpoint every N steps (0 = only at the end)")
     p.add_argument("--resume", default="False", type=str)
+    p.add_argument("--val_frac", default=0.0, type=float,
+                   help="hold out this fraction of the corpus tail for "
+                        "validation (0 = off); val_loss/val_ppl columns "
+                        "join the CSV")
+    p.add_argument("--val_every", default=0, type=int,
+                   help="validate every N steps (0 = only at the end); "
+                        "must be a multiple of --print_freq since val "
+                        "rows ride the CSV print cadence")
+    p.add_argument("--val_batches", default=8, type=int,
+                   help="validation batches per evaluation")
+    p.add_argument("--profile_dir", default=None, type=str,
+                   help="capture a jax.profiler trace of steps 2..4 into "
+                        "this directory (TensorBoard format)")
     # multi-host (same surface as gossip_sgd)
     p.add_argument("--multihost", default="auto",
                    choices=["auto", "True", "False"],
@@ -329,6 +342,26 @@ def main(argv=None):
             train_fn = shard_lm_train_step(
                 step, mesh, seq_axis=SEQ_AXIS if ring else None, tp=tp > 1)
 
+    val_on = args.val_frac > 0
+    if val_on and (pp > 1 or ep > 1):
+        raise SystemExit("--val_frac is not supported with --pp/--ep yet "
+                         "(their eval would need the pipelined/dispatched "
+                         "forward; train-loss tracking still works)")
+    if val_on and args.val_every and args.val_every % args.print_freq:
+        raise SystemExit(
+            f"--val_every {args.val_every} must be a multiple of "
+            f"--print_freq {args.print_freq} (validation rows ride the "
+            "CSV print cadence)")
+    eval_fn = None
+    if val_on:
+        from ..train.lm import build_lm_eval_step, shard_lm_eval_step
+
+        ev = build_lm_eval_step(model, alg,
+                                seq_axis=SEQ_AXIS if ring else None)
+        eval_fn = shard_lm_eval_step(ev, mesh,
+                                     seq_axis=SEQ_AXIS if ring else None,
+                                     tp=tp > 1)
+
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree.leaves(
                        jax.tree.map(lambda a: a[0], state.params)))
@@ -387,6 +420,14 @@ def main(argv=None):
 
     corpus = synthetic_lm_corpus(args.corpus_tokens,
                                  vocab_size=args.vocab_size, seed=args.seed)
+    val_corpus = None
+    if val_on:
+        # hold out the corpus tail; at least one full validation batch
+        min_val = (args.seq_len + 1) * dp * args.batch_size
+        n_val = max(int(len(corpus) * args.val_frac), min_val)
+        if n_val >= len(corpus) // 2:
+            raise SystemExit("--val_frac leaves too little training data")
+        corpus, val_corpus = corpus[:-n_val], corpus[-n_val:]
     os.makedirs(args.checkpoint_dir, exist_ok=True)
     out_fname = os.path.join(
         args.checkpoint_dir,
@@ -396,14 +437,15 @@ def main(argv=None):
     if not (start_step and os.path.isfile(out_fname)):
         with open(out_fname, "w") as f:
             print("step,loss,ppl,lr,tokens_per_sec"
-                  + (",moe_dropped" if moe_on else ""), file=f)
+                  + (",moe_dropped" if moe_on else "")
+                  + (",val_loss,val_ppl" if val_on else ""), file=f)
 
     loss_meter = Meter(ptag="Loss")
     steps_done = start_step
     # resume fast-forward: restart the data stream where the saved run
     # left off instead of replaying consumed batches (≙ the sampler
     # fast-forward of the image harness, gossip_sgd.py:356-364)
-    n_seqs = (args.corpus_tokens - 1) // args.seq_len
+    n_seqs = (len(corpus) - 1) // args.seq_len
     batches_per_epoch = max(1, n_seqs // (dp * ep * args.batch_size))
     epoch = start_step // batches_per_epoch
     skip_batches = start_step % batches_per_epoch
@@ -432,6 +474,27 @@ def main(argv=None):
         # sharded metrics are not host-addressable on a pod: all-gather
         return (to_host(m, mesh) if proc_count > 1
                 else jax.tree.map(np.asarray, m))
+
+    def run_validation(st):
+        """Mean held-out loss over --val_batches batches (≙ validate,
+        gossip_sgd.py:440-471)."""
+        vals = []
+        for vt, vy in lm_batches(val_corpus, dp, sp, args.batch_size,
+                                 args.seq_len, seed=1):
+            if not ring:
+                vt = vt.reshape(dp, args.batch_size, args.seq_len)
+                vy = vy.reshape(dp, args.batch_size, args.seq_len)
+            m = eval_fn(st, globalize(vt), globalize(vy))
+            if serialize:
+                jax.block_until_ready(m)
+            vals.append(float(np.mean(host_metrics(m)["loss"])))
+            if len(vals) >= args.val_batches:
+                break
+        vl = float(np.mean(vals))
+        return vl, float(np.exp(vl))
+
+    last_val = None
+    prof_started = prof_stopped = False
     while steps_done < args.num_steps:
         for tokens, targets in lm_batches(corpus, dp * ep, sp,
                                           args.batch_size, args.seq_len,
@@ -463,6 +526,15 @@ def main(argv=None):
             if serialize:
                 jax.block_until_ready(state)
             steps_done += 1
+            if args.profile_dir and not prof_stopped:
+                # bounded trace window: steps 2-4 (step 1 pays the compile)
+                if not prof_started and steps_done == start_step + 1:
+                    jax.profiler.start_trace(args.profile_dir)
+                    prof_started = True
+                elif prof_started and steps_done >= start_step + 4:
+                    jax.block_until_ready(state)
+                    jax.profiler.stop_trace()
+                    prof_stopped = True
             if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
                 mh = host_metrics(metrics)
                 loss = float(np.mean(mh["loss"]))
@@ -475,6 +547,16 @@ def main(argv=None):
                        f"{tps:.0f}")
                 if moe_on:
                     row += (",%.4f" % float(np.mean(mh['moe_dropped'])))
+                if val_on:
+                    val_due = ((args.val_every and steps_done
+                                % args.val_every == 0)
+                               or steps_done >= args.num_steps)
+                    if val_due:
+                        vl, vppl = run_validation(state)
+                        last_val = vl
+                        row += f",{vl:.4f},{vppl:.2f}"
+                    else:
+                        row += ",,"
                 with open(out_fname, "a") as f:
                     print(row, file=f)
             if args.ckpt_every and steps_done % args.ckpt_every == 0:
@@ -485,10 +567,14 @@ def main(argv=None):
         epoch += 1
     if last_saved != steps_done:
         save_ckpt(state, steps_done)
+    if prof_started and not prof_stopped:
+        jax.profiler.stop_trace()
 
     result = {"final_loss": loss_meter.val, "avg_loss": loss_meter.avg,
               "tokens_per_sec": tokens_per_step
               * (steps_done - start_step) / (time.time() - t0)}
+    if last_val is not None:
+        result["val_loss"] = last_val
     log.info(json.dumps(result))
     return result
 

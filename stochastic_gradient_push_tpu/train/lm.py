@@ -41,6 +41,7 @@ __all__ = ["SEQ_AXIS", "TP_AXIS", "EP_AXIS", "make_dp_sp_mesh",
            "make_dp_tp_mesh", "make_dp_sp_tp_mesh", "make_dp_ep_mesh",
            "make_dp_ep_sp_mesh",
            "build_lm_train_step", "shard_lm_train_step",
+           "build_lm_eval_step", "shard_lm_eval_step",
            "shard_scanned_lm_step", "lm_loss",
            "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
            "init_lm_state_tp", "ep_state_specs", "init_lm_state_ep"]
@@ -327,6 +328,52 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
         in_specs=(state_spec, batch_spec, batch_spec),
         out_specs=(state_spec, P(gossip_axis)), **kwargs)
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def build_lm_eval_step(model, algorithm: GossipAlgorithm,
+                       seq_axis: str | None = None) -> tp.Callable:
+    """Per-rank LM eval: de-biased params, no gossip, no state update
+    (≙ ``validate``, gossip_sgd.py:440-471 — every rank evaluates
+    independently; only the seq mean is collective)."""
+
+    def eval_step(state: TrainState, tokens, targets):
+        z = algorithm.eval_params(state.params, state.gossip)
+        logits = model.apply({"params": z}, tokens, train=False)
+        ce = lm_loss(logits, targets)
+        if seq_axis is not None:
+            ce = lax.pmean(ce, seq_axis)
+        return {"loss": ce, "ppl": jnp.exp(ce)}
+
+    return eval_step
+
+
+def shard_lm_eval_step(eval_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
+                       seq_axis: str | None = SEQ_AXIS, tp: bool = False):
+    """Wrap an LM eval step for the mesh (mirrors
+    :func:`shard_lm_train_step`, metrics only, no donation)."""
+    if seq_axis is None:
+        batch_spec = P(gossip_axis)
+        squeeze_n = 1
+    else:
+        batch_spec = P(gossip_axis, seq_axis)
+        squeeze_n = 2
+
+    def wrapped(state, tokens, targets):
+        sq_state = jax.tree.map(lambda a: a[0], state)
+        sq = lambda t: jax.tree.map(
+            lambda a: a.reshape(a.shape[squeeze_n:]), t)
+        metrics = eval_fn(sq_state, sq(tokens), sq(targets))
+        return jax.tree.map(lambda a: a[None], metrics)
+
+    kwargs = {}
+    if tp:
+        kwargs["axis_names"] = {gossip_axis} | (
+            {seq_axis} if seq_axis else set())
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(gossip_axis), batch_spec, batch_spec),
+        out_specs=P(gossip_axis), **kwargs)
+    return jax.jit(sharded)
 
 
 def shard_scanned_lm_step(step_fn, mesh, n_steps: int,

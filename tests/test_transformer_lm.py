@@ -234,3 +234,28 @@ def test_scanned_lm_step_matches_sequential():
                         jax.tree.leaves(state2.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-5, atol=1e-6)
+
+
+def test_lm_cli_validation(tmp_path):
+    """--val_frac holds out corpus tail; val_loss/val_ppl columns appear at
+    --val_every steps and at the end, for both plain and ring layouts."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    for extra in ([], ["--sp", "2"]):
+        d = tmp_path / ("ring" if extra else "plain")
+        r = main(["--world_size", "8", "--seq_len", "32", "--d_model",
+                  "32", "--n_layers", "1", "--n_heads", "4", "--d_ff",
+                  "32", "--vocab_size", "32", "--batch_size", "2",
+                  "--corpus_tokens", "30000", "--print_freq", "2",
+                  "--num_steps", "4", "--val_frac", "0.1",
+                  "--val_every", "2", "--val_batches", "2",
+                  "--checkpoint_dir", str(d)] + extra)
+        assert np.isfinite(r["val_loss"])
+        csv = (d / "lm_out_n8.csv").read_text().splitlines()
+        assert csv[0].endswith("val_loss,val_ppl")
+        val_rows = [l for l in csv[1:] if l.split(",")[5]]
+        assert val_rows, csv
+        for l in val_rows:
+            assert np.isfinite(float(l.split(",")[5]))
