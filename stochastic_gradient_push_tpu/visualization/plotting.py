@@ -17,8 +17,9 @@ import re
 
 import pandas as pd
 
-__all__ = ["parse_csv", "parse_transformer_out", "plot_itrs",
-           "plot_scaling", "plot_transformer", "ITERATIONS_PER_EPOCH"]
+__all__ = ["parse_csv", "parse_lm_csv", "parse_transformer_out",
+           "plot_itrs", "plot_lm", "plot_scaling", "plot_transformer",
+           "ITERATIONS_PER_EPOCH"]
 
 # iterations per epoch at batch 256/node on ImageNet
 # (≙ plotting.py:196-197)
@@ -134,6 +135,44 @@ def plot_transformer(fpaths: dict[str, str], out_path: str | None = None):
             ax.plot(df["wall"] / 3600.0, df["loss"], label=label)
     ax.set_xlabel("wall time (h)")
     ax.set_ylabel("NLL")
+    ax.legend()
+    if out_path:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    return fig
+
+
+def parse_lm_csv(fpath: str) -> "pd.DataFrame":
+    """Parse an LM harness CSV (run/gossip_lm.py: header
+    ``step,loss,ppl,lr,tokens_per_sec[,moe_dropped][,val_loss,val_ppl]``).
+
+    The reference had no in-repo LM harness (its transformer runs lived in
+    an external fairseq fork, parsed by :func:`parse_transformer_out`);
+    this parses the native LM family's logs instead.  Validation columns,
+    when present, are populated only on validation rows.
+    """
+    df = pd.read_csv(fpath)
+    df.columns = [c.strip() for c in df.columns]
+    return df
+
+
+def plot_lm(fpaths: dict[str, str], out_path: str | None = None,
+            x: str = "step"):
+    """Train (and, when logged, validation) loss curves for labelled LM
+    runs — the in-repo counterpart of :func:`plot_transformer`."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for label, fpath in fpaths.items():
+        df = parse_lm_csv(fpath)
+        if not len(df):
+            continue
+        ax.plot(df[x], df["loss"], label=label)
+        if "val_loss" in df.columns:
+            val = df.dropna(subset=["val_loss"])
+            if len(val):
+                ax.plot(val[x], val["val_loss"], linestyle="--",
+                        label=f"{label} (val)")
+    ax.set_xlabel(x)
+    ax.set_ylabel("loss (nats/token)")
     ax.legend()
     if out_path:
         fig.savefig(out_path, dpi=120, bbox_inches="tight")

@@ -292,3 +292,28 @@ def test_trainer_watchdog_fires_on_slow_step(tmp_path):
         verbose=False)
     assert Trainer(cfg0, TinyMLP(num_classes=4), mesh,
                    sample_input_shape=(4, 8, 8, 3)).watchdog is None
+
+
+def test_parse_and_plot_lm_csv(tmp_path):
+    """LM CSVs (with and without validation columns) parse and plot."""
+    from stochastic_gradient_push_tpu.visualization.plotting import (
+        parse_lm_csv, plot_lm)
+
+    plain = tmp_path / "lm_out_n8.csv"
+    plain.write_text("step,loss,ppl,lr,tokens_per_sec\n"
+                     "2,4.5,90.0,0.1,1000\n4,4.2,66.7,0.1,1200\n")
+    withval = tmp_path / "lm_val_out_n8.csv"
+    withval.write_text(
+        "step,loss,ppl,lr,tokens_per_sec,val_loss,val_ppl\n"
+        "2,4.5,90.0,0.1,1000,,\n4,4.2,66.7,0.1,1200,4.3,73.7\n")
+
+    df = parse_lm_csv(str(plain))
+    assert list(df["step"]) == [2, 4]
+    dfv = parse_lm_csv(str(withval))
+    assert dfv["val_loss"].notna().sum() == 1
+
+    fig = plot_lm({"SGP": str(plain), "SGP+val": str(withval)},
+                  out_path=str(tmp_path / "lm.png"))
+    assert (tmp_path / "lm.png").exists()
+    import matplotlib.pyplot
+    matplotlib.pyplot.close(fig)
