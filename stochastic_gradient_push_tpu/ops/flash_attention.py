@@ -16,9 +16,15 @@ flash-attention-2 decomposition —
 * dK/dV kernel, grid over (batch·head, k-block): streams q/do blocks,
   accumulates ``dv += pᵀ @ do`` and ``dk += dsᵀ @ q``.
 
-Both use ``delta = rowsum(do · o)`` (a cheap XLA elementwise reduce) in
-place of materializing dP.  Causal runs skip the empty triangle blocks in
-both kernels.
+Both use ``delta = rowsum(do · o)`` in place of materializing dP; it is
+computed *inside* the kernels from the streamed ``o``/``do`` blocks (an
+elementwise multiply-reduce, negligible next to the matmuls), so no delta
+array ever exists in HBM.  The logsumexp residual travels in a compact
+``[rows, 1]`` layout — a round-2 revision materialized lse and delta as
+lane-broadcast ``[rows, 128]`` fp32 HBM operands (128× their logical
+size; 2 MB of VMEM each per grid cell at t=4096, the likely cause of the
+recorded dk/dv slowdown at long sequence — docs/FLASH_TPU_RESULTS.txt).
+Causal runs skip the empty triangle blocks in both kernels.
 
 On non-TPU backends ``flash_attention`` transparently falls back to the
 pure-JAX blockwise implementation
@@ -41,17 +47,18 @@ __all__ = ["flash_attention", "flash_attention_forward",
 
 NEG_INF = -1e30
 
-# Mosaic requires the last two block dims be (8·k, 128·k) or full-size; a
-# per-row scalar like the logsumexp therefore rides in a [rows, LANES]
-# layout with the value broadcast across the 128 lanes (the same trick the
-# reference TPU kernels use).  Lane 0 is read back at the boundary.
-LANES = 128
+# Mosaic requires the last two block dims be (8·k, 128·k) or full-size.
+# Per-row scalars (the logsumexp) ride as a [rows, 1] column — the last
+# dim is the ARRAY's full size (1), which Mosaic accepts, so the residual
+# costs t floats instead of the 128·t a lane-broadcast layout would.
+SCALAR_COLS = 1
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, block_q: int,
                   block_k: int, seq_len: int, causal: bool):
     """One (batch·head, q-block) cell.  Refs: q [block_q, d];
-    k/v [seq, d]; o [block_q, d]; lse [block_q, LANES]."""
+    k/v [seq, d]; o [block_q, d]; lse (when requested)
+    [block_q, SCALAR_COLS]."""
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     q = q_ref[:].astype(jnp.float32) * (d ** -0.5)
@@ -94,10 +101,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         upper = num_k_blocks
     m, den, acc = jax.lax.fori_loop(0, upper, body, (m, den, acc))
     o_ref[:] = (acc / den[:, None]).astype(o_ref.dtype)
-    # per-row logsumexp of the scaled scores — the backward's residual —
-    # broadcast across the lane dim (see LANES)
-    lse_ref[:] = jnp.broadcast_to((m + jnp.log(den))[:, None],
-                                  (block_q, LANES))
+    if maybe_lse:
+        # per-row logsumexp of the scaled scores — the backward's residual
+        lse_ref, = maybe_lse
+        lse_ref[:] = (m + jnp.log(den))[:, None]
 
 
 def flash_attention_forward(q, k, v, causal: bool = False,
@@ -123,7 +130,16 @@ def flash_attention_forward(q, k, v, causal: bool = False,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_len=t,
         causal=causal)
-    out, lse = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
+    if return_lse:
+        out_specs.append(pl.BlockSpec((None, block_q, SCALAR_COLS),
+                                      lambda bh, qi: (bh, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, t, SCALAR_COLS),
+                                              jnp.float32))
+    results = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
         in_specs=[
@@ -131,35 +147,31 @@ def flash_attention_forward(q, k, v, causal: bool = False,
             pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t, LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qf, kf, vf)
-    out = out.reshape(b, h, t, d)
     if return_lse:
-        return out, lse[..., 0].reshape(b, h, t)
-    return out
+        out, lse = results
+        return out.reshape(b, h, t, d), lse[..., 0].reshape(b, h, t)
+    out, = results
+    return out.reshape(b, h, t, d)
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                      dq_ref, *, block_q: int, block_k: int, seq_len: int,
                      causal: bool):
-    """dQ cell: one (batch·head, q-block); k/v/do stream through.
-    Refs: q/do/dq [block_q, d]; k/v [seq, d]; lse/delta
-    [block_q, LANES] (lane-broadcast scalars, see LANES)."""
+    """dQ cell: one (batch·head, q-block); k/v stream through.
+    Refs: q/o/do/dq [block_q, d]; k/v [seq, d]; lse
+    [block_q, SCALAR_COLS].  ``delta = rowsum(do · o)`` is computed here
+    rather than shipped as an operand."""
     qi = pl.program_id(1)
     d = q_ref.shape[-1]
     scale = d ** -0.5
     q = q_ref[:].astype(jnp.float32) * scale
     do = do_ref[:].astype(jnp.float32)
     lse = lse_ref[:][:, 0]
-    delta = delta_ref[:][:, 0]
+    delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=-1)
 
     num_k_blocks = seq_len // block_k
     dq = jnp.zeros((block_q, d), jnp.float32)
@@ -194,12 +206,14 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                       dk_ref, dv_ref, *, block_q: int, block_k: int,
                       seq_len: int, causal: bool):
-    """dK/dV cell: one (batch·head, k-block); q/do stream through.
-    Refs: k/v/dk/dv [block_k, d]; q/do [seq, d]; lse/delta
-    [seq, LANES] (lane-broadcast scalars, see LANES)."""
+    """dK/dV cell: one (batch·head, k-block); q/o/do stream through.
+    Refs: k/v/dk/dv [block_k, d]; q/o/do [seq, d]; lse
+    [seq, SCALAR_COLS].  delta is recomputed per streamed q-block from
+    ``do · o`` — an elementwise reduce per (k-block, q-block) pair,
+    negligible next to the four matmuls in the same body."""
     kj = pl.program_id(1)
     d = k_ref.shape[-1]
     scale = d ** -0.5
@@ -216,7 +230,9 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.float32) * scale
         do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         lse_blk = lse_ref[pl.ds(qi * block_q, block_q), :][:, 0]
-        delta_blk = delta_ref[pl.ds(qi * block_q, block_q), :][:, 0]
+        delta_blk = jnp.sum(
+            do_blk * o_ref[pl.ds(qi * block_q, block_q), :].astype(
+                jnp.float32), axis=-1)
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
@@ -254,8 +270,10 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
                              interpret: bool = False):
     """Fused Pallas backward: returns ``(dq, dk, dv)``.
 
-    ``lse`` is the forward's row logsumexp ``[b, h, seq]``; ``delta`` is
-    computed here as ``rowsum(do · out)`` (one cheap XLA reduce).
+    ``lse`` is the forward's row logsumexp ``[b, h, seq]``, shipped in the
+    compact ``[rows, 1]`` layout; ``delta = rowsum(do · out)`` is computed
+    inside the kernels from the streamed ``out``/``do`` blocks, so neither
+    scalar family ever exists as a lane-broadcast HBM array.
     """
     b, h, t, d = q.shape
     block_q = min(block_q, t)
@@ -267,24 +285,18 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
+    of = out.reshape(b * h, t, d)
     dof = do.reshape(b * h, t, d)
-    # lane-broadcast the per-row scalars into the [rows, LANES] layout the
-    # kernels require (see LANES)
-    lsef = jnp.broadcast_to(lse.reshape(b * h, t)[..., None],
-                            (b * h, t, LANES))
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(b * h, t)
-    delta = jnp.broadcast_to(delta[..., None], (b * h, t, LANES))
+    lsef = lse.reshape(b * h, t)[..., None]  # [b*h, t, SCALAR_COLS]
 
     row_specs = [
         pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
         pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # k
         pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # v
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # o
         pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # do
-        pl.BlockSpec((None, block_q, LANES),
+        pl.BlockSpec((None, block_q, SCALAR_COLS),
                      lambda bh, qi: (bh, qi, 0)),                      # lse
-        pl.BlockSpec((None, block_q, LANES),
-                     lambda bh, qi: (bh, qi, 0)),                      # δ
     ]
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q,
@@ -295,15 +307,16 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
                                lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, of, dof, lsef)
 
     col_specs = [
         pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # q
         pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # k
         pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # v
+        pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # o
         pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # do
-        pl.BlockSpec((None, t, LANES), lambda bh, kj: (bh, 0, 0)),     # lse
-        pl.BlockSpec((None, t, LANES), lambda bh, kj: (bh, 0, 0)),     # δ
+        pl.BlockSpec((None, t, SCALAR_COLS),
+                     lambda bh, kj: (bh, 0, 0)),                       # lse
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q,
@@ -319,7 +332,7 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
             jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, of, dof, lsef)
     return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
             dv.reshape(b, h, t, d))
 

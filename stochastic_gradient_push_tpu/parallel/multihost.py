@@ -36,7 +36,8 @@ __all__ = ["owned_ranks", "owned_batch_rows", "make_global_batch",
            "consensus_resume_point"]
 
 
-def consensus_resume_point(epoch: int, itr: int) -> tuple[int, int]:
+def consensus_resume_point(epoch: int, itr: int,
+                           log=None) -> tuple[int, int]:
     """Agree on one resume point across processes.
 
     Per-process checkpoint files can tear under preemption (one host saved
@@ -45,6 +46,12 @@ def consensus_resume_point(epoch: int, itr: int) -> tuple[int, int]:
     *minimum* (epoch, itr) any process holds — re-running a stretch of data
     on the ahead processes is harmless (their state simply trains on), a
     mismatched collective count is fatal.
+
+    When ``log`` is given, a disagreement is loudly recorded: replicas
+    restored from a later step silently carry newer parameters while the
+    data stream fast-forwards to the consensus step; gossip averaging
+    reconciles them over time, but the divergence should never be
+    invisible in the logs.
     """
     if jax.process_count() == 1:
         return epoch, itr
@@ -53,7 +60,15 @@ def consensus_resume_point(epoch: int, itr: int) -> tuple[int, int]:
     mine = np.asarray([epoch, itr], np.int64)
     all_pts = np.asarray(
         multihost_utils.process_allgather(mine)).reshape(-1, 2)
-    e, i = min((int(r[0]), int(r[1])) for r in all_pts)
+    pts = sorted({(int(r[0]), int(r[1])) for r in all_pts})
+    e, i = pts[0]
+    if log is not None and len(pts) > 1:
+        log.warning(
+            f"restored checkpoints disagree across processes: {pts} — "
+            f"resuming all from {(e, i)}; replicas restored from later "
+            "steps carry newer parameters until gossip averaging "
+            "reconciles them (a torn save window, e.g. preemption "
+            "mid-checkpoint)")
     return e, i
 
 

@@ -26,10 +26,27 @@ from __future__ import annotations
 
 import typing as tp
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_spmd"]
+__all__ = ["pipeline_spmd", "pvary_missing"]
+
+
+def pvary_missing(x, axes):
+    """Mark ``x`` varying over any of ``axes`` it isn't already varying
+    over (idempotent pvary — a plain pvary/pcast raises on an
+    already-varying axis)."""
+    try:
+        have = jax.typeof(x).vma
+    except Exception:
+        have = frozenset()
+    need = tuple(a for a in axes if a not in have)
+    if not need:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, need, to="varying")
+    return lax.pvary(x, need)
 
 
 def pipeline_spmd(body: tp.Callable, x_micro: jnp.ndarray,
@@ -55,13 +72,12 @@ def pipeline_spmd(body: tp.Callable, x_micro: jnp.ndarray,
     M = x_micro.shape[0]
     # the carry becomes device-varying over pipe after the first ppermute;
     # mark the zero initializers as varying up front so the scan carry type
-    # is stable (shard_map's varying-manual-axes tracking)
-    if hasattr(lax, "pcast"):
-        mark = lambda x, ax: lax.pcast(x, ax, to="varying")
-    else:  # older spelling
-        mark = lax.pvary
-    buf = mark(jnp.zeros_like(x_micro[0]), (pipe_axis,))
-    out = mark(jnp.zeros_like(x_micro), (pipe_axis,))
+    # is stable (shard_map's varying-manual-axes tracking).  zeros_like
+    # inherits x_micro's axes, which may already include pipe (e.g. when
+    # the embed producing x_micro is gated on the stage index) — hence the
+    # idempotent mark
+    buf = pvary_missing(jnp.zeros_like(x_micro[0]), (pipe_axis,))
+    out = pvary_missing(jnp.zeros_like(x_micro), (pipe_axis,))
     shift = [(i, (i + 1) % S) for i in range(S)]
 
     def tick(carry, t):

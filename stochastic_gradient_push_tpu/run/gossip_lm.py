@@ -396,7 +396,7 @@ def main(argv=None):
             local_state, meta = ckpt.restore(local_tmpl)
             state = global_state_from_local(mesh, GOSSIP_AXIS, local_state)
             _, start_step = consensus_resume_point(
-                0, int(meta.get("step", 0)))
+                0, int(meta.get("step", 0)), log=log)
             log.info(f"resumed from step {start_step}")
         elif ckpt.exists():
             log.info("checkpoint present here but missing on a peer; "
@@ -475,9 +475,18 @@ def main(argv=None):
         return (to_host(m, mesh) if proc_count > 1
                 else jax.tree.map(np.asarray, m))
 
+    val_time = 0.0  # excluded from the throughput window (see below)
+
     def run_validation(st):
         """Mean held-out loss over --val_batches batches (≙ validate,
-        gossip_sgd.py:440-471)."""
+        gossip_sgd.py:440-471).
+
+        Wall time spent here — including the eval_fn compile on the first
+        call — is accumulated into ``val_time`` and subtracted from the
+        elapsed time used for tokens_per_sec, so validation cadence
+        doesn't deflate the reported training throughput."""
+        nonlocal val_time
+        t_val = time.time()
         vals = []
         for vt, vy in lm_batches(val_corpus, dp, sp, args.batch_size,
                                  args.seq_len, seed=1):
@@ -491,6 +500,7 @@ def main(argv=None):
             if len(vals) >= args.val_batches:
                 break
         vl = float(np.mean(vals))
+        val_time += time.time() - t_val
         return vl, float(np.exp(vl))
 
     last_val = None
@@ -540,7 +550,7 @@ def main(argv=None):
                 loss = float(np.mean(mh["loss"]))
                 loss_meter.update(loss)
                 tps = (tokens_per_step * (steps_done - start_step)
-                       / (time.time() - t0))
+                       / (time.time() - t0 - val_time))
                 row = (f"{steps_done},{loss:.4f},"
                        f"{float(np.mean(mh['ppl'])):.2f},"
                        f"{float(np.mean(mh['lr'])):.5f},"
@@ -572,7 +582,8 @@ def main(argv=None):
 
     result = {"final_loss": loss_meter.val, "avg_loss": loss_meter.avg,
               "tokens_per_sec": tokens_per_step
-              * (steps_done - start_step) / (time.time() - t0)}
+              * (steps_done - start_step)
+              / (time.time() - t0 - val_time)}
     if last_val is not None:
         result["val_loss"] = last_val
     log.info(json.dumps(result))

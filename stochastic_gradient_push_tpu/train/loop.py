@@ -374,7 +374,7 @@ class Trainer:
                 # collectives deadlock
                 from ..parallel.multihost import consensus_resume_point
                 start_epoch, start_itr = consensus_resume_point(
-                    start_epoch, start_itr)
+                    start_epoch, start_itr, log=self.log)
             best_prec1 = meta.get("best_prec1", 0.0)
             elapsed = meta.get("elapsed_time", 0.0)
             for m, k in zip(meters, ("batch_meter", "nn_meter",

@@ -39,15 +39,15 @@ from jax.sharding import PartitionSpec as P
 from ..algorithms.api import GossipAlgorithm
 from ..parallel.collectives import as_scalar
 from ..parallel.mesh import GOSSIP_AXIS
-from ..parallel.pipeline import pipeline_spmd
+from ..parallel.pipeline import pipeline_spmd, pvary_missing
 from .lm import _make_mesh, lm_loss
 from .state import TrainState
 
 PIPE_AXIS = "pipe"
 
 __all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "pp_state_specs",
-           "init_pp_state", "pipeline_forward", "build_pp_train_step",
-           "shard_pp_train_step"]
+           "init_pp_state", "pipeline_hidden", "pipeline_forward",
+           "build_pp_train_step", "shard_pp_train_step"]
 
 
 def make_dp_pp_mesh(dp: int, pp: int, devices=None):
@@ -73,19 +73,88 @@ def pp_state_specs(state, gossip_axis: str = GOSSIP_AXIS,
         state)
 
 
-def pipeline_forward(model, params, tokens: jnp.ndarray,
-                     pipe_axis: str = PIPE_AXIS) -> jnp.ndarray:
-    """Pipelined forward: ``[M, b, t]`` tokens → ``[M, b, t, V]`` logits
-    (valid on the last stage only — mask-and-psum before use)."""
+# Stage-gating discipline (the ``lax.cond``s below): the predicate
+# (``lax.axis_index``) is device-varying over pipe, so each device takes
+# its own branch.  Collectives must therefore never appear inside a
+# branch — including the *implicit* pvary a replicated operand picks up
+# when it meets a varying one, whose TRANSPOSE is a psum: a psum inside
+# divergent control flow deadlocks.  Hence every differentiable operand
+# is cast varying (pvary_missing) OUTSIDE the cond and passed in as an
+# explicit, already-varying operand; the transpose-psum then lands
+# outside the cond, uniform across devices.  Dead branches build their
+# zero outputs from a ``* 0`` taint of a varying operand so both
+# branches carry identical varying-axes types.
+
+
+def _pipe_varying(tree, pipe_axis):
+    return jax.tree.map(lambda a: pvary_missing(a, (pipe_axis,)), tree)
+
+
+def _stage_gated(pred, live_fn, operands):
+    """``lax.cond(pred, live_fn, <zeros>, operands)`` under the
+    collective-free-branch discipline above.
+
+    ``operands`` must already be pipe-varying (``_pipe_varying`` /
+    ``pvary_missing``).  The dead branch returns zeros of ``live_fn``'s
+    output shape, tainted by a ``* 0`` reduction of every operand leaf
+    (folded away by XLA) so both branches carry identical varying-axes
+    types."""
+    out_t = jax.eval_shape(live_fn, operands)
+
+    def dead(ops):
+        taint = sum((a * 0).sum().astype(out_t.dtype)
+                    for a in jax.tree_util.tree_leaves(ops))
+        return jnp.zeros(out_t.shape, out_t.dtype) + taint
+
+    return lax.cond(pred, live_fn, dead, operands)
+
+
+def pipeline_hidden(model, params, tokens: jnp.ndarray,
+                    pipe_axis: str = PIPE_AXIS) -> jnp.ndarray:
+    """Pipelined stack body: ``[M, b, t]`` tokens → ``[M, b, t, D]`` hidden
+    states (valid on the last stage only).
+
+    Embedding is gated to stage 0 with ``lax.cond`` — the other stages'
+    copies were always dead operands (pipeline_spmd's inject ``where``
+    carries zero gradient through them), so skipping the lookup changes
+    nothing numerically but drops the wasted gather per stage.
+    """
     positions = jnp.arange(tokens.shape[-1])
-    x = model.apply({"params": params}, tokens, method="embed_tokens")
+    stage = lax.axis_index(pipe_axis)
+    pv = _pipe_varying(params, pipe_axis)
+    tv = pvary_missing(tokens, (pipe_axis,))
+
+    def embed_live(ops):
+        q, t = ops
+        return model.apply({"params": q}, t, method="embed_tokens")
+
+    x = _stage_gated(stage == 0, embed_live, (pv, tv))
 
     def body(h):
         return model.apply({"params": params}, h, positions,
                            method="blocks")
 
-    out = pipeline_spmd(body, x, pipe_axis)
-    return model.apply({"params": params}, out, method="head")
+    return pipeline_spmd(body, x, pipe_axis)
+
+
+def pipeline_forward(model, params, tokens: jnp.ndarray,
+                     pipe_axis: str = PIPE_AXIS) -> jnp.ndarray:
+    """Pipelined forward: ``[M, b, t]`` tokens → ``[M, b, t, V]`` logits
+    (valid on the last stage only — other stages return zeros; mask-and-
+    psum before use).  The full-vocab head projection runs on the last
+    stage alone (``lax.cond``): running it everywhere and masking after
+    multiplied the most expensive matmul — and the fp32 logits buffer —
+    by the stage count."""
+    stage = lax.axis_index(pipe_axis)
+    S = lax.axis_size(pipe_axis)
+    out = pipeline_hidden(model, params, tokens, pipe_axis)
+    pv = _pipe_varying(params, pipe_axis)
+
+    def head_live(ops):
+        q, h = ops
+        return model.apply({"params": q}, h, method="head")
+
+    return _stage_gated(stage == S - 1, head_live, (pv, out))
 
 
 def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
@@ -102,13 +171,23 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         stage = lax.axis_index(pipe_axis)
 
         def loss_fn(p):
-            logits = pipeline_forward(model, p, tokens, pipe_axis)
-            ce = lm_loss(logits, targets)
-            # only the last stage's logits are live.  Return the MASKED
-            # per-shard value (summed over shards it equals the true loss):
-            # a psum here would transpose into a second psum and scale
-            # every gradient by the stage count
-            return jnp.where(stage == S - 1, ce, 0.0)
+            hidden = pipeline_hidden(model, p, tokens, pipe_axis)
+            pv = _pipe_varying(p, pipe_axis)
+            yv = pvary_missing(targets, (pipe_axis,))
+
+            def live(ops):
+                q, h, y = ops
+                logits = model.apply({"params": q}, h, method="head")
+                return lm_loss(logits, y)
+
+            # only the last stage's activations are live: gate the head
+            # projection + CE behind the stage index so the [M,b,t,V] fp32
+            # logits (and their FLOPs) exist on one stage, not S.  The
+            # result is the same MASKED per-shard value as before (summed
+            # over shards it equals the true loss): a psum here would
+            # transpose into a second psum and scale every gradient by the
+            # stage count
+            return _stage_gated(stage == S - 1, live, (pv, hidden, yv))
 
         masked_loss, grads = jax.value_and_grad(loss_fn)(z)
         # share the scalar for metrics only, after differentiation

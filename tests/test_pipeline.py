@@ -125,6 +125,39 @@ class TestPipelineParity:
         np.testing.assert_allclose(float(np.asarray(metrics["loss"])[0]),
                                    float(ref_loss), rtol=2e-5, atol=2e-5)
 
+    def test_pipeline_forward_logits_match_stacked_model(self):
+        """pipeline_forward (the exported inference path) produces the
+        stacked model's logits on the last stage and exact zeros elsewhere
+        — psum over pipe recovers the full logits."""
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from stochastic_gradient_push_tpu.train.pp import (
+            PIPE_AXIS, pipeline_forward, pp_state_specs)
+
+        n_layers, pp, n_micro = 2, 2, 2
+        model, cfg, state, _, toks, _ = _setup(1, pp, n_layers, n_micro)
+        ref_params = _assemble_reference_params(state, 0, n_layers)
+        ref_model = TransformerLM(cfg._replace(remat=False))
+        flat_t = toks[0].reshape(-1, toks.shape[-1])
+        ref_logits = np.asarray(
+            ref_model.apply({"params": ref_params}, flat_t))
+
+        mesh = make_dp_pp_mesh(1, pp)
+        specs = pp_state_specs(state.params)
+
+        def fwd(params, tokens):
+            p = jax.tree.map(lambda a: a[0], params)
+            logits = pipeline_forward(model, p, tokens[0])
+            return lax.psum(logits, PIPE_AXIS)[None]
+
+        sm = jax.shard_map(fwd, mesh=mesh,
+                           in_specs=(specs, P(GOSSIP_AXIS)),
+                           out_specs=P(GOSSIP_AXIS))
+        got = np.asarray(jax.jit(sm)(state.params, toks))[0]
+        np.testing.assert_allclose(got.reshape(ref_logits.shape),
+                                   ref_logits, rtol=2e-5, atol=2e-5)
+
     def test_remat_matches(self):
         n_layers, pp, n_micro = 2, 2, 2
         _, _, state, train_fn, toks, tgts = _setup(1, pp, n_layers, n_micro)
