@@ -12,12 +12,14 @@ Pairs with ``parallel/pipeline.py`` (the tick schedule) and ``train/pp.py``
   pipe axis, so a gathered checkpoint holds the full ``L``-layer model.
 
 The block itself is the shared ``_Block`` from models/transformer.py —
-pipeline parallelism changes the layout, not the math.  MoE and ring
-attention are fenced (composition matrix, ARCHITECTURE.md): the pipe loop
-moves *activations* between shards, while MoE/ring move *tokens/KV* inside
-a layer — composing them would nest manual collectives over different axes
-inside the scanned tick body; per-block routing over ep inside a stage is
-the planned extension.
+pipeline parallelism changes the layout, not the math.  Ring attention
+composes (pp × sp): the tick's ppermute moves activations over ``pipe``
+while each block's ring rotation moves KV over ``seq`` — different manual
+axes, both uniform collectives inside the scanned tick body, so they
+nest cleanly (tests/test_pipeline.py pins parity with the stacked ring
+model).  MoE stays fenced (composition matrix, ARCHITECTURE.md): its
+all_to_all dispatch would need per-block routing inside a stage — the
+planned extension.
 """
 
 from __future__ import annotations
@@ -57,9 +59,6 @@ class PipelineStageLM(nn.Module):
         if cfg.moe_experts > 0:
             raise ValueError("MoE × pipeline is fenced — see ARCHITECTURE.md"
                              " composition matrix")
-        if cfg.attn_impl == "ring" or cfg.seq_axis is not None:
-            raise ValueError("ring attention × pipeline is fenced — see "
-                             "ARCHITECTURE.md composition matrix")
         self.embed = nn.Embed(cfg.vocab_size, cfg.d_model,
                               embedding_init=nn.initializers.normal(0.02),
                               dtype=cfg.dtype)
@@ -99,6 +98,12 @@ class PipelineStageLM(nn.Module):
         del train
         tokens = tokens.reshape(-1, tokens.shape[-1])  # merge microbatch dims
         positions = jnp.arange(tokens.shape[-1])
+        if self.cfg.seq_axis is not None:
+            # ring attention: this shard holds one contiguous block; its
+            # global positions start at the block offset
+            from jax import lax
+            positions = positions + lax.axis_index(
+                self.cfg.seq_axis) * tokens.shape[-1]
         x = self.embed_tokens(tokens)
         x = self.blocks(x, positions)
         return self.head(x)

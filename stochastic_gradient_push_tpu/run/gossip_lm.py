@@ -164,12 +164,15 @@ def main(argv=None):
     if sp < 1 or tp < 1 or ep < 1 or pp < 1:
         raise SystemExit("--sp, --tp, --ep and --pp must be >= 1")
     if pp > 1:
-        # pipeline composes with gossip DP only (ARCHITECTURE.md matrix):
-        # the tick loop moves activations between shards while sp/ep move
-        # tokens/KV inside a layer — nesting them is fenced
-        if sp > 1 or tp > 1 or ep > 1 or args.moe_experts:
-            raise SystemExit("--pp composes with gossip DP only "
-                             "(not --sp/--tp/--ep/--moe_experts)")
+        # pipeline composes with gossip DP and — since round 3 — with
+        # ring-attention sequence parallelism (the tick's ppermute moves
+        # activations over pipe while ring attention rotates KV over seq:
+        # different manual axes, both uniform in the tick body).  MoE's
+        # all_to_all dispatch inside a stage and tp remain fenced
+        # (ARCHITECTURE.md matrix).
+        if tp > 1 or ep > 1 or args.moe_experts:
+            raise SystemExit("--pp composes with gossip DP and --sp only "
+                             "(not --tp/--ep/--moe_experts)")
         if args.n_micro < 1:
             raise SystemExit(f"--n_micro must be >= 1 (got {args.n_micro})")
         if args.n_layers % pp:
@@ -178,10 +181,9 @@ def main(argv=None):
         if args.batch_size % args.n_micro:
             raise SystemExit(f"batch_size {args.batch_size} not divisible "
                              f"by n_micro {args.n_micro}")
-    if ep > 1 and tp > 1:
-        raise SystemExit("--ep does not compose with --tp (expert-slice "
-                         "kernels cannot be simultaneously ep-manual and "
-                         "tp-auto on the same dims)")
+    if ep > 1 and tp > 1 and sp > 1:
+        raise SystemExit("--ep × --tp × --sp (a 4-D mesh) is not "
+                         "supported; drop one axis")
     # --moe_experts with --sp > 1 (no ep): per-block routing — every
     # sequence shard routes its own block's tokens with per-block capacity;
     # expert weights are replicated over seq.  Routing is per-token, so
@@ -201,11 +203,15 @@ def main(argv=None):
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
     if pp > 1:
         from ..train.pp import (build_pp_train_step, init_pp_state,
-                                make_dp_pp_mesh, pp_state_specs,
-                                shard_pp_train_step)
-        mesh = make_dp_pp_mesh(dp, pp)
+                                make_dp_pp_mesh, make_dp_pp_sp_mesh,
+                                pp_state_specs, shard_pp_train_step)
+        mesh = (make_dp_pp_sp_mesh(dp, pp, sp) if sp > 1
+                else make_dp_pp_mesh(dp, pp))
     elif ep > 1 and sp > 1:
         mesh = make_dp_ep_sp_mesh(dp, ep, sp)
+    elif ep > 1 and tp > 1:
+        from ..train.lm import make_dp_ep_tp_mesh
+        mesh = make_dp_ep_tp_mesh(dp, ep, tp)
     elif ep > 1:
         mesh = make_dp_ep_mesh(dp, ep)
     elif sp > 1 and tp > 1:
@@ -216,13 +222,17 @@ def main(argv=None):
         mesh = make_dp_sp_mesh(dp, sp)
 
     if proc_count > 1:
-        # per-process feeding/checkpointing is wired for the dp and dp×sp
-        # meshes; ep/tp/pp shard state on non-leading dims (or via GSPMD),
-        # which the per-process rank-row checkpoint layout cannot slice
-        if ep > 1 or tp > 1 or pp > 1:
-            raise SystemExit("--ep/--tp/--pp with --multihost are not "
-                             "supported yet; use dp or dp×sp meshes on "
-                             "pods")
+        # per-process feeding works on every mesh; checkpoints need a
+        # layout that can hold arbitrary shardings.  dp/dp×sp states
+        # slice cleanly into per-process rank-row msgpack files; ep/tp
+        # states shard on non-leading dims (or via GSPMD), so those
+        # meshes use the orbax global-state backend instead (one shared
+        # root, each process writes its own shards).  pp stays fenced:
+        # its microbatch reshapes and stage-gated eval aren't wired for
+        # per-process feeding yet.
+        if pp > 1:
+            raise SystemExit("--pp with --multihost is not supported "
+                             "yet; use dp/dp×sp/ep/tp meshes on pods")
         log.info(f"process {proc_index}/{proc_count}: multihost LM over "
                  f"{mesh}")
 
@@ -252,8 +262,9 @@ def main(argv=None):
         raise SystemExit(
             "--ep with ring attention needs --sp > 1 (the 3-D "
             "gossip × ep × seq mesh)")
-    if pp > 1 and attn == "ring":
-        raise SystemExit("--pp does not compose with ring attention")
+    if pp > 1 and attn == "ring" and sp == 1:
+        raise SystemExit("--pp with ring attention needs --sp > 1 "
+                         "(the 3-D gossip × pipe × seq mesh)")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -310,8 +321,9 @@ def main(argv=None):
         state = init_pp_state(model, mesh, alg, tx, dp=dp, pp=pp,
                               n_micro=args.n_micro,
                               micro_batch=args.batch_size // args.n_micro,
-                              seq_len=args.seq_len, seed=args.seed)
-        train_fn = shard_pp_train_step(step, mesh, pp_state_specs(state))
+                              seq_len=args.seq_len, seed=args.seed, sp=sp)
+        train_fn = shard_pp_train_step(step, mesh, pp_state_specs(state),
+                                       seq_axis=SEQ_AXIS if ring else None)
     else:
         step = build_lm_train_step(
             model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
@@ -324,7 +336,8 @@ def main(argv=None):
                                      sp=sp)
             train_fn = shard_lm_train_step(
                 step, mesh, seq_axis=SEQ_AXIS if ring else None,
-                state_specs=ep_state_specs(state), ep_axis=EP_AXIS)
+                state_specs=ep_state_specs(state), ep_axis=EP_AXIS,
+                tp=tp > 1)
         elif tp > 1 and not ring:
             from ..train.lm import init_lm_state_tp
 
@@ -377,9 +390,20 @@ def main(argv=None):
                                       host_local_slice, to_host)
     from ..utils.checkpoint import CheckpointManager
 
-    ckpt = CheckpointManager(args.checkpoint_dir, tag=args.tag,
-                             rank=proc_index, world_size=world,
-                             all_workers=proc_count > 1)
+    # ep/tp multihost states shard on non-leading dims — the rank-row
+    # msgpack slicing cannot represent them, but orbax's global-state mode
+    # holds any sharding (every process writes its own shards of ONE
+    # logical checkpoint)
+    orbax_global = proc_count > 1 and (ep > 1 or tp > 1)
+    if orbax_global:
+        from ..utils.orbax_ckpt import OrbaxCheckpointManager
+
+        ckpt = OrbaxCheckpointManager(args.checkpoint_dir, tag=args.tag,
+                                      rank=proc_index, world_size=world)
+    else:
+        ckpt = CheckpointManager(args.checkpoint_dir, tag=args.tag,
+                                 rank=proc_index, world_size=world,
+                                 all_workers=proc_count > 1)
     shardings = jax.tree.map(lambda a: a.sharding, state)
     start_step = 0
     if sb(args.resume) and proc_count > 1:
@@ -392,9 +416,15 @@ def main(argv=None):
         all_have = int(np.min(np.asarray(multihost_utils.process_allgather(
             np.asarray([int(ckpt.exists())])))))
         if all_have:
-            local_tmpl = host_local_slice(state)
-            local_state, meta = ckpt.restore(local_tmpl)
-            state = global_state_from_local(mesh, GOSSIP_AXIS, local_state)
+            if orbax_global:
+                # one shared logical checkpoint: the live sharded state is
+                # the restore template, every process reads its own shards
+                state, meta = ckpt.restore(state)
+            else:
+                local_tmpl = host_local_slice(state)
+                local_state, meta = ckpt.restore(local_tmpl)
+                state = global_state_from_local(mesh, GOSSIP_AXIS,
+                                                local_state)
             _, start_step = consensus_resume_point(
                 0, int(meta.get("step", 0)), log=log)
             log.info(f"resumed from step {start_step}")
@@ -415,8 +445,11 @@ def main(argv=None):
                 "tokens_per_sec": 0.0, "already_complete": True}
 
     def save_ckpt(st, step):
-        ckpt.save(host_local_slice(st) if proc_count > 1 else st,
-                  {"step": step})
+        if orbax_global:
+            ckpt.save(st, {"step": step}, epoch_id=step)
+        else:
+            ckpt.save(host_local_slice(st) if proc_count > 1 else st,
+                      {"step": step})
 
     corpus = synthetic_lm_corpus(args.corpus_tokens,
                                  vocab_size=args.vocab_size, seed=args.seed)
@@ -458,7 +491,11 @@ def main(argv=None):
     metrics = None
     if proc_count > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
-        bspec = P(GOSSIP_AXIS, SEQ_AXIS) if ring else P(GOSSIP_AXIS)
+        if ep > 1:
+            bspec = (P(GOSSIP_AXIS, EP_AXIS, SEQ_AXIS) if ring
+                     else P(GOSSIP_AXIS, EP_AXIS))
+        else:
+            bspec = P(GOSSIP_AXIS, SEQ_AXIS) if ring else P(GOSSIP_AXIS)
         bsharding = NamedSharding(mesh, bspec)
 
         def globalize(arr):
@@ -512,7 +549,15 @@ def main(argv=None):
             if skip_batches:
                 skip_batches -= 1
                 continue
-            if pp > 1:
+            if pp > 1 and ring:
+                # [dp, sp, b, block] → [dp, sp, M, mb, block]: the batch
+                # dim splits into microbatches inside each seq shard
+                micro_b = args.batch_size // args.n_micro
+                shape = (dp, sp, args.n_micro, micro_b,
+                         args.seq_len // sp)
+                tokens = tokens.reshape(shape)
+                targets = targets.reshape(shape)
+            elif pp > 1:
                 micro_b = args.batch_size // args.n_micro
                 tokens = tokens.reshape(dp, args.n_micro, micro_b,
                                         args.seq_len)
@@ -577,6 +622,9 @@ def main(argv=None):
         epoch += 1
     if last_saved != steps_done:
         save_ckpt(state, steps_done)
+    if orbax_global:
+        ckpt.wait()
+        ckpt.close()
     if prof_started and not prof_stopped:
         jax.profiler.stop_trace()
 

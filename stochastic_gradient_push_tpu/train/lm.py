@@ -39,11 +39,12 @@ EP_AXIS = "ep"
 
 __all__ = ["SEQ_AXIS", "TP_AXIS", "EP_AXIS", "make_dp_sp_mesh",
            "make_dp_tp_mesh", "make_dp_sp_tp_mesh", "make_dp_ep_mesh",
-           "make_dp_ep_sp_mesh",
+           "make_dp_ep_sp_mesh", "make_dp_ep_tp_mesh",
            "build_lm_train_step", "shard_lm_train_step",
            "build_lm_eval_step", "shard_lm_eval_step",
            "shard_scanned_lm_step", "lm_loss",
            "init_lm_state", "apply_tp_sharding", "tp_sharding_tree",
+           "ep_tp_sharding_tree",
            "init_lm_state_tp", "ep_state_specs", "init_lm_state_ep"]
 
 
@@ -83,6 +84,21 @@ def make_dp_ep_mesh(dp: int, ep: int, devices=None) -> Mesh:
     hierarchical local axis) while expert slices stay shard-local.
     """
     return _make_mesh((dp, ep), (GOSSIP_AXIS, EP_AXIS), devices)
+
+
+def make_dp_ep_tp_mesh(dp: int, ep: int, tp: int, devices=None) -> Mesh:
+    """3-D ``(gossip, ep, tp)`` mesh: gossip × expert × tensor
+    parallelism.
+
+    Experts shard over the *manual* ep axis (all_to_all token dispatch)
+    while the tp axis stays *auto*: GSPMD partitions each expert slice's
+    FFN dims — and every dense sublayer's Megatron dims — over tp
+    according to the arrays' own shardings (:func:`ep_tp_sharding_tree`).
+    The manual collectives (gossip ppermute, ep all_to_all) never mention
+    tp, so the two regimes compose without a hand-written hybrid kernel.
+    """
+    return _make_mesh((dp, ep, tp), (GOSSIP_AXIS, EP_AXIS, TP_AXIS),
+                      devices)
 
 
 def make_dp_ep_sp_mesh(dp: int, ep: int, sp: int, devices=None) -> Mesh:
@@ -126,6 +142,29 @@ _TP_EXPERT_COLUMN = {"experts_up"}      # [E, D, F]: shard F
 _TP_EXPERT_ROW = {"experts_down"}       # [E, F, D]: shard F
 
 
+def _tp_tail(path, leaf, tp_axis: str) -> list:
+    """Per-leaf PartitionSpec tail (dims after the leading gossip dim)
+    with the Megatron tp placement: projection kernels column-/row-
+    parallel by module name, expert stacks on their FFN dim, everything
+    else replicated.  Shared by every tp-aware sharding tree so the
+    classification rules exist exactly once."""
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    ndim = jnp.ndim(leaf)
+    tail = [None] * (ndim - 1)
+    if ndim >= 3 and names and names[-1] == "kernel":
+        parent = names[-2]
+        if parent in _TP_COLUMN:
+            tail[-1] = tp_axis
+        elif parent in _TP_ROW:
+            tail[-2] = tp_axis
+    elif ndim >= 4 and names:
+        if names[-1] in _TP_EXPERT_COLUMN:
+            tail[-1] = tp_axis
+        elif names[-1] in _TP_EXPERT_ROW:
+            tail[-2] = tp_axis
+    return tail
+
+
 def tp_sharding_tree(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
                      tp_axis: str = TP_AXIS):
     """NamedShardings for a gossip-stacked LM tree with Megatron-style
@@ -141,21 +180,25 @@ def tp_sharding_tree(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
     from jax.sharding import NamedSharding
 
     def spec_for(path, leaf):
-        names = [getattr(p, "key", getattr(p, "name", str(p)))
-                 for p in path]
-        ndim = jnp.ndim(leaf)
-        tail = [None] * (ndim - 1)
-        if ndim >= 3 and names and names[-1] == "kernel":
-            parent = names[-2]
-            if parent in _TP_COLUMN:
-                tail[-1] = tp_axis
-            elif parent in _TP_ROW:
-                tail[-2] = tp_axis
-        elif ndim >= 4 and names:
-            if names[-1] in _TP_EXPERT_COLUMN:
-                tail[-1] = tp_axis
-            elif names[-1] in _TP_EXPERT_ROW:
-                tail[-2] = tp_axis
+        tail = _tp_tail(path, leaf, tp_axis)
+        return NamedSharding(mesh, P(gossip_axis, *tail))
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def ep_tp_sharding_tree(tree, mesh, gossip_axis: str = GOSSIP_AXIS,
+                        ep_axis: str = EP_AXIS, tp_axis: str = TP_AXIS):
+    """NamedShardings for the ep × tp composition: expert leaves shard
+    ``ep`` on their leading expert dim AND ``tp`` on their FFN dim
+    (column/row by name, as in :func:`tp_sharding_tree`); dense projection
+    kernels shard ``tp`` Megatron-style and replicate over ep; everything
+    else replicates over both.  Works on arrays or avals."""
+    from jax.sharding import NamedSharding
+
+    def spec_for(path, leaf):
+        tail = _tp_tail(path, leaf, tp_axis)
+        if _is_expert_path(path) and tail:
+            tail[0] = ep_axis
         return NamedSharding(mesh, P(gossip_axis, *tail))
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
@@ -312,7 +355,8 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
     kwargs = {}
     if tp:
         # the tp mesh axis stays auto: GSPMD partitions per-rank compute
-        manual = {gossip_axis} | ({seq_axis} if seq_axis else set())
+        manual = {gossip_axis} | ({seq_axis} if seq_axis else set()) \
+            | ({ep_axis} if ep_axis else set())
         kwargs["axis_names"] = manual
     state_spec = P(gossip_axis) if state_specs is None else state_specs
     if ep_axis is not None and seq_axis is not None:
@@ -511,8 +555,16 @@ def init_lm_state_ep(model, mesh, algorithm, tx, dp: int, ep: int,
 
     in_spec = (P(GOSSIP_AXIS, EP_AXIS, SEQ_AXIS) if ring
                else P(GOSSIP_AXIS, EP_AXIS))
+    has_tp = TP_AXIS in mesh.axis_names
+    sm_kwargs = {}
+    if has_tp:
+        # ep × tp: only gossip/ep (and seq) are manual; tp stays auto so
+        # GSPMD lays the init out per ep_tp_sharding_tree
+        sm_kwargs["axis_names"] = {GOSSIP_AXIS, EP_AXIS} | (
+            {SEQ_AXIS} if ring else set())
     sm_init = jax.shard_map(
-        init_fn, mesh=mesh, in_specs=(in_spec,), out_specs=param_specs)
+        init_fn, mesh=mesh, in_specs=(in_spec,), out_specs=param_specs,
+        **sm_kwargs)
     dummy_shape = ((dp, ep, sp, batch_size, seq_len // sp) if ring
                    else (dp, ep, batch_size, seq_len))
     dummy = np.zeros(dummy_shape, np.int32)
@@ -527,7 +579,10 @@ def init_lm_state_ep(model, mesh, algorithm, tx, dp: int, ep: int,
             gossip=replicate_state(algorithm.init(one(params)), dp))
 
     shapes = jax.eval_shape(build, dummy)
-    specs = ep_state_specs(shapes)
-    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
-                             is_leaf=lambda x: isinstance(x, P))
+    if has_tp:
+        shardings = ep_tp_sharding_tree(shapes, mesh)
+    else:
+        specs = ep_state_specs(shapes)
+        shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
     return jax.jit(build, out_shardings=shardings)(dummy)

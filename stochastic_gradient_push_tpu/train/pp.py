@@ -45,7 +45,8 @@ from .state import TrainState
 
 PIPE_AXIS = "pipe"
 
-__all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "pp_state_specs",
+__all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "make_dp_pp_sp_mesh",
+           "pp_state_specs",
            "init_pp_state", "pipeline_hidden", "pipeline_forward",
            "build_pp_train_step", "shard_pp_train_step"]
 
@@ -54,6 +55,16 @@ def make_dp_pp_mesh(dp: int, pp: int, devices=None):
     """2-D ``(gossip, pipe)`` mesh: dp gossip replicas × pp pipeline
     stages inside each replica."""
     return _make_mesh((dp, pp), (GOSSIP_AXIS, PIPE_AXIS), devices)
+
+
+def make_dp_pp_sp_mesh(dp: int, pp: int, sp: int, devices=None):
+    """3-D ``(gossip, pipe, seq)`` mesh: pp × sp composition — the tick
+    schedule's ppermute moves activations over ``pipe`` while each
+    block's ring attention rotates KV over ``seq``; different manual
+    axes, so the two collectives nest cleanly in the scanned tick body."""
+    from .lm import SEQ_AXIS
+    return _make_mesh((dp, pp, sp), (GOSSIP_AXIS, PIPE_AXIS, SEQ_AXIS),
+                      devices)
 
 
 def _is_stage_path(path) -> bool:
@@ -109,6 +120,15 @@ def _stage_gated(pred, live_fn, operands):
     return lax.cond(pred, live_fn, dead, operands)
 
 
+def _model_seq_axis(model) -> str | None:
+    """The seq axis is part of the model's own config (ring attention
+    references it inside the blocks), so position offsets derive from the
+    same source — a separately-threaded parameter could silently disagree
+    with the attention's actual rotation axis."""
+    cfg = getattr(model, "cfg", None)
+    return getattr(cfg, "seq_axis", None)
+
+
 def pipeline_hidden(model, params, tokens: jnp.ndarray,
                     pipe_axis: str = PIPE_AXIS) -> jnp.ndarray:
     """Pipelined stack body: ``[M, b, t]`` tokens → ``[M, b, t, D]`` hidden
@@ -118,8 +138,16 @@ def pipeline_hidden(model, params, tokens: jnp.ndarray,
     copies were always dead operands (pipeline_spmd's inject ``where``
     carries zero gradient through them), so skipping the lookup changes
     nothing numerically but drops the wasted gather per stage.
+
+    When the model's config carries a ``seq_axis`` (pp × sp) each shard
+    holds one contiguous block of every sequence; positions carry the
+    block offset and the stage body's ring attention rotates KV over
+    ``seq`` inside each tick.
     """
+    seq_axis = _model_seq_axis(model)
     positions = jnp.arange(tokens.shape[-1])
+    if seq_axis is not None:
+        positions = positions + lax.axis_index(seq_axis) * tokens.shape[-1]
     stage = lax.axis_index(pipe_axis)
     pv = _pipe_varying(params, pipe_axis)
     tv = pvary_missing(tokens, (pipe_axis,))
@@ -162,7 +190,10 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                         pipe_axis: str = PIPE_AXIS) -> tp.Callable:
     """Per-rank pipelined LM step ``(state, tokens, targets) ->
     (state, metrics)``; same four-slot algorithm structure as every other
-    step builder (train/step.py)."""
+    step builder (train/step.py).  When the model's config carries a
+    ``seq_axis`` the stage bodies run ring attention over the seq shards
+    (pp × sp) and gradients/metrics renormalize over seq."""
+    seq_axis = _model_seq_axis(model)
 
     def train_step(state: TrainState, tokens, targets):
         params, gstate = algorithm.pre_step(state.params, state.gossip)
@@ -192,6 +223,12 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         masked_loss, grads = jax.value_and_grad(loss_fn)(z)
         # share the scalar for metrics only, after differentiation
         loss = lax.psum(masked_loss, pipe_axis)
+        if seq_axis is not None:
+            # params are seq-invariant → autodiff psums grads over the seq
+            # shards' per-block CE; divide for the global token mean
+            n_seq = lax.axis_size(seq_axis)
+            grads = jax.tree.map(lambda g: g / n_seq, grads)
+            loss = lax.pmean(loss, seq_axis)
         # no manual grad psum over pipe: replicated leaves (embed/head/ln_f)
         # are device-INVARIANT over pipe, so autodiff transposes their
         # implicit pvary into a psum — their grads arrive already summed
@@ -215,15 +252,25 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
 
 def shard_pp_train_step(step_fn, mesh, state_specs,
-                        gossip_axis: str = GOSSIP_AXIS):
-    """Wrap for the ``(gossip, pipe)`` mesh: state per ``state_specs``
-    (see :func:`pp_state_specs`); batches ``[dp, M, b, t]`` with
-    ``P(gossip)`` — replicated over pipe."""
-    batch_spec = P(gossip_axis)
+                        gossip_axis: str = GOSSIP_AXIS,
+                        seq_axis: str | None = None):
+    """Wrap for the ``(gossip, pipe[, seq])`` mesh: state per
+    ``state_specs`` (see :func:`pp_state_specs`); batches
+    ``[dp, M, b, t]`` with ``P(gossip)`` (replicated over pipe) — or,
+    with ``seq_axis``, ``[dp, sp, M, b, block]`` with
+    ``P(gossip, seq)`` (the lm_batches block layout with the microbatch
+    split applied to the batch dim)."""
+    if seq_axis is None:
+        batch_spec = P(gossip_axis)
+        squeeze_n = 1
+    else:
+        batch_spec = P(gossip_axis, seq_axis)
+        squeeze_n = 2
 
     def wrapped(state, tokens, targets):
         sq_state = jax.tree.map(lambda a: a[0], state)
-        new_state, metrics = step_fn(sq_state, tokens[0], targets[0])
+        sq = lambda t: t.reshape(t.shape[squeeze_n:])
+        new_state, metrics = step_fn(sq_state, sq(tokens), sq(targets))
         return (jax.tree.map(lambda a: a[None], new_state),
                 jax.tree.map(lambda a: a[None], metrics))
 
@@ -236,8 +283,9 @@ def shard_pp_train_step(step_fn, mesh, state_specs,
 
 def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
                   n_micro: int, micro_batch: int, seq_len: int,
-                  seed: int = 0) -> TrainState:
-    """Initialize pipeline-parallel LM state on a ``(gossip, pipe)`` mesh.
+                  seed: int = 0, sp: int = 1) -> TrainState:
+    """Initialize pipeline-parallel LM state on a ``(gossip, pipe)`` mesh
+    — or ``(gossip, pipe, seq)`` with ``sp > 1`` (pp × sp).
 
     Parameter init runs under shard_map: every pipe shard draws its own
     stack slice with a pipe-index-folded RNG (so all ``L`` global layers
@@ -248,10 +296,15 @@ def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
     """
     from jax.sharding import NamedSharding
 
+    from .lm import SEQ_AXIS
     from .step import replicate_state
 
+    ring = sp > 1
+    block = seq_len // sp
+    lead = 2 if ring else 1  # leading sharded batch dims to strip
+
     def init_fn(toks):
-        t = toks[0]  # strip gossip lead → [M, b, seq]
+        t = toks.reshape(toks.shape[lead:])  # → [M, b, block]
         common = model.init(jax.random.PRNGKey(seed), t)["params"]
         local = model.init(
             jax.random.fold_in(jax.random.PRNGKey(seed),
@@ -263,16 +316,26 @@ def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
             common, local)
         return jax.tree.map(lambda a: a[None], params)
 
+    # param STRUCTURE (paths only): with ring attention the live model
+    # references the seq axis, so probe an axis-free twin of the config
+    probe_model = model
+    if getattr(model.cfg, "seq_axis", None) is not None:
+        probe_model = type(model)(
+            model.cfg._replace(seq_axis=None, attn_impl="full"),
+            n_local_layers=model.n_local_layers)
     probe = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(seed),
-                           jnp.zeros((n_micro, micro_batch, seq_len),
-                                     jnp.int32)))
+        lambda: probe_model.init(jax.random.PRNGKey(seed),
+                                 jnp.zeros((n_micro, micro_batch, block),
+                                           jnp.int32)))
     param_specs = pp_state_specs(probe["params"])
 
+    in_spec = P(GOSSIP_AXIS, SEQ_AXIS) if ring else P(GOSSIP_AXIS)
     sm_init = jax.shard_map(init_fn, mesh=mesh,
-                            in_specs=(P(GOSSIP_AXIS),),
+                            in_specs=(in_spec,),
                             out_specs=param_specs)
-    dummy = np.zeros((dp, n_micro, micro_batch, seq_len), np.int32)
+    dummy_shape = ((dp, sp, n_micro, micro_batch, block) if ring
+                   else (dp, n_micro, micro_batch, seq_len))
+    dummy = np.zeros(dummy_shape, np.int32)
 
     def build(d):
         params = sm_init(d)
