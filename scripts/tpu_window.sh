@@ -1,0 +1,52 @@
+#!/bin/bash
+# Capture every real-TPU artifact in one pass, highest value first.
+#
+# The axon chip tunnel is flaky (round 1: backend init hung; round 2: the
+# end-of-round bench timed out).  When a probe shows the chip alive, run
+# this script immediately — it orders the work so that whatever moment the
+# tunnel dies again, the most important numbers are already on disk:
+#
+#   1. bench.py            — the headline ResNet-50 SGP number (+MFU, AR)
+#   2. bench_flash_tpu.py  — validates the compact-[rows,1]-lse kernels on
+#                            real Mosaic (interpret mode cannot catch lane
+#                            layout bugs — round-2 lesson) + perf vs XLA
+#   3. bench_lm_tpu.py     — transformer tokens/sec incl. scanned steps
+#
+# Results land under docs/tpu_runs/<UTC timestamp>/ and the flash summary
+# should replace docs/FLASH_TPU_RESULTS.txt when it improves on it.
+#
+# Usage: bash scripts/tpu_window.sh   (leave JAX_PLATFORMS alone: the TPU
+# platform is 'axon'; forcing 'tpu' fails.  PYTHONPATH must keep
+# /root/.axon_site FIRST or the TPU plugin is clobbered.)
+
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+OUT="$REPO/docs/tpu_runs/$(date -u +%Y%m%dT%H%M%S)"
+mkdir -p "$OUT"
+cd "$REPO"
+
+probe() {
+  timeout 75 python -c "import jax; d=jax.devices(); print(d[0].device_kind, len(d))" 2>/dev/null
+}
+
+echo "== probe =="
+KIND=$(probe) || { echo "TPU unreachable; aborting" | tee "$OUT/ABORTED"; exit 1; }
+echo "chip: $KIND" | tee "$OUT/chip.txt"
+
+echo "== 1/3 bench.py (headline) =="
+BENCH_BATCH="${BENCH_BATCH:-128}" BENCH_SCAN="${BENCH_SCAN:-5}" \
+  timeout 900 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.jsonl"
+
+echo "== 2/3 flash kernels (numerics + timing vs XLA) =="
+timeout 900 python examples/bench_flash_tpu.py \
+  > "$OUT/flash.txt" 2>"$OUT/flash.err"
+tail -8 "$OUT/flash.txt"
+
+echo "== 3/3 LM bench =="
+timeout 900 python examples/bench_lm_tpu.py \
+  > "$OUT/lm.txt" 2>"$OUT/lm.err"
+tail -6 "$OUT/lm.txt"
+
+echo "== done: $OUT =="
+ls -la "$OUT"

@@ -17,9 +17,11 @@ composes (pp × sp): the tick's ppermute moves activations over ``pipe``
 while each block's ring rotation moves KV over ``seq`` — different manual
 axes, both uniform collectives inside the scanned tick body, so they
 nest cleanly (tests/test_pipeline.py pins parity with the stacked ring
-model).  MoE stays fenced (composition matrix, ARCHITECTURE.md): its
-all_to_all dispatch would need per-block routing inside a stage — the
-planned extension.
+model).  Replicated-expert MoE composes too (``moe_every=1`` so the
+scanned stack stays uniform; tokens route per microbatch inside the
+ticks).  Still fenced (composition matrix, ARCHITECTURE.md): pp × ep —
+expert-sharded dispatch would need its all_to_all inside a stage — and
+the MoE × pipeline × sp triple.
 """
 
 from __future__ import annotations
@@ -39,7 +41,9 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        return _Block(self.cfg, name="block")(x, positions), None
+        use_moe = self.cfg.moe_experts > 0
+        return _Block(self.cfg, use_moe=use_moe,
+                      name="block")(x, positions), None
 
 
 class PipelineStageLM(nn.Module):
@@ -56,18 +60,28 @@ class PipelineStageLM(nn.Module):
 
     def setup(self):
         cfg = self.cfg
-        if cfg.moe_experts > 0:
-            raise ValueError("MoE × pipeline is fenced — see ARCHITECTURE.md"
-                             " composition matrix")
+        if cfg.moe_experts > 0 and cfg.moe_every != 1:
+            raise ValueError(
+                "MoE × pipeline requires moe_every=1: the stage stack is "
+                "one uniform nn.scan, so every layer must share the block "
+                "structure — see ARCHITECTURE.md composition matrix")
+        if cfg.moe_experts > 0 and cfg.ep_axis is not None:
+            raise ValueError("pp × ep is fenced — see ARCHITECTURE.md "
+                             "composition matrix")
+        if cfg.moe_experts > 0 and cfg.seq_axis is not None:
+            raise ValueError("MoE × pipeline × sp is fenced — see "
+                             "ARCHITECTURE.md composition matrix")
         self.embed = nn.Embed(cfg.vocab_size, cfg.d_model,
                               embedding_init=nn.initializers.normal(0.02),
                               dtype=cfg.dtype)
         target = _ScanBlock
         if cfg.remat:
             target = nn.remat(target, prevent_cse=False)
+        # sown MoE collections ("losses"/"moe_metrics") stack per-layer on
+        # axis 0 like the params; harmless when nothing is sown
         self.stack = nn.scan(
             target,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "losses": 0, "moe_metrics": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=self.n_local_layers)(cfg)

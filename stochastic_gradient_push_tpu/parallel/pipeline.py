@@ -50,22 +50,31 @@ def pvary_missing(x, axes):
 
 
 def pipeline_spmd(body: tp.Callable, x_micro: jnp.ndarray,
-                  pipe_axis: str) -> jnp.ndarray:
+                  pipe_axis: str, with_aux: bool = False):
     """Run ``body`` as one pipeline stage over rotating microbatches.
 
     Args:
       body: the stage function ``h -> h`` (this shard's slice of the layer
-        stack); same input/output shape.
+        stack); same input/output shape.  With ``with_aux`` the body
+        returns ``(h, aux)`` where aux is a pytree of scalars (e.g. MoE
+        load-balance losses).
       x_micro: ``[M, ...]`` stacked microbatch activations.  Every shard
         passes the same array; only stage 0 actually consumes it (the other
         shards' copies are dead code after the ``where`` and carry zero
         gradient).
       pipe_axis: mesh axis name the stages live on.
+      with_aux: also return the per-tick aux summed over this stage's
+        *valid* ticks (stage ``s`` processes microbatch ``t - s`` at tick
+        ``t``; fill/drain bubble ticks run the body on garbage and their
+        aux is masked to zero — with zero gradient — by the same
+        ``where`` discipline as the inject/collect path).
 
     Returns:
       ``[M, ...]`` stage outputs — **valid on the last stage only**; other
       shards hold garbage.  Mask by ``lax.axis_index(pipe_axis)`` and
-      ``lax.psum`` to share (see train/pp.py).
+      ``lax.psum`` to share (see train/pp.py).  With ``with_aux``:
+      ``(out, aux_sum)`` where aux_sum is the masked per-stage sum over
+      its M valid ticks.
     """
     S = lax.axis_size(pipe_axis)
     stage = lax.axis_index(pipe_axis)
@@ -80,12 +89,33 @@ def pipeline_spmd(body: tp.Callable, x_micro: jnp.ndarray,
     out = pvary_missing(jnp.zeros_like(x_micro), (pipe_axis,))
     shift = [(i, (i + 1) % S) for i in range(S)]
 
+    aux0 = None
+    if with_aux:
+        aux_shapes = jax.eval_shape(lambda h: body(h)[1], x_micro[0])
+        # zeros tainted by x_micro (* 0, folded away) so the scan carry's
+        # varying-axes type matches the in-loop accumulator from tick one
+        taint = (x_micro * 0).sum()
+        aux0 = jax.tree.map(
+            lambda a: pvary_missing(
+                jnp.zeros(a.shape, a.dtype) + taint.astype(a.dtype),
+                (pipe_axis,)),
+            aux_shapes)
+
     def tick(carry, t):
-        buf, out = carry
+        buf, out, aux_acc = carry
         inject = lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, M - 1), 0, keepdims=False)
         h = jnp.where(stage == 0, inject, buf)
-        h = body(h)
+        if with_aux:
+            h, aux = body(h)
+            # this stage holds microbatch t - stage at tick t; anything
+            # else is a fill/drain bubble whose aux must not contribute
+            m_idx = t - stage
+            live = (m_idx >= 0) & (m_idx < M)
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + jnp.where(live, a, 0), aux_acc, aux)
+        else:
+            h = body(h)
         # collect on the last stage: tick t finishes microbatch t - (S - 1)
         idx = jnp.clip(t - (S - 1), 0, M - 1)
         valid = (stage == S - 1) & (t >= S - 1)
@@ -95,7 +125,10 @@ def pipeline_spmd(body: tp.Callable, x_micro: jnp.ndarray,
         # hand the activation to the next stage; the wrap-around edge
         # (last -> 0) carries garbage that stage 0's inject overwrites
         buf = lax.ppermute(h, pipe_axis, shift)
-        return (buf, out), None
+        return (buf, out, aux_acc), None
 
-    (_, out), _ = lax.scan(tick, (buf, out), jnp.arange(M + S - 1))
+    (_, out, aux_sum), _ = lax.scan(tick, (buf, out, aux0),
+                                    jnp.arange(M + S - 1))
+    if with_aux:
+        return out, aux_sum
     return out

@@ -167,12 +167,21 @@ def main(argv=None):
         # pipeline composes with gossip DP and — since round 3 — with
         # ring-attention sequence parallelism (the tick's ppermute moves
         # activations over pipe while ring attention rotates KV over seq:
-        # different manual axes, both uniform in the tick body).  MoE's
-        # all_to_all dispatch inside a stage and tp remain fenced
-        # (ARCHITECTURE.md matrix).
-        if tp > 1 or ep > 1 or args.moe_experts:
-            raise SystemExit("--pp composes with gossip DP and --sp only "
-                             "(not --tp/--ep/--moe_experts)")
+        # different manual axes, both uniform in the tick body) and with
+        # replicated-expert MoE (every layer an expert block, routed per
+        # microbatch inside the ticks).  ep's all_to_all dispatch inside
+        # a stage and tp remain fenced (ARCHITECTURE.md matrix).
+        if tp > 1 or ep > 1:
+            raise SystemExit("--pp composes with gossip DP, --sp and "
+                             "--moe_experts only (not --tp/--ep)")
+        if args.moe_experts:
+            if args.moe_every != 1:
+                raise SystemExit("--pp with --moe_experts requires "
+                                 "--moe_every 1 (the stage stack is one "
+                                 "uniform scan)")
+            if sp > 1:
+                raise SystemExit("--pp × --sp × --moe_experts is not "
+                                 "supported; drop one axis")
         if args.n_micro < 1:
             raise SystemExit(f"--n_micro must be >= 1 (got {args.n_micro})")
         if args.n_layers % pp:
@@ -224,15 +233,10 @@ def main(argv=None):
     if proc_count > 1:
         # per-process feeding works on every mesh; checkpoints need a
         # layout that can hold arbitrary shardings.  dp/dp×sp states
-        # slice cleanly into per-process rank-row msgpack files; ep/tp
+        # slice cleanly into per-process rank-row msgpack files; ep/tp/pp
         # states shard on non-leading dims (or via GSPMD), so those
         # meshes use the orbax global-state backend instead (one shared
-        # root, each process writes its own shards).  pp stays fenced:
-        # its microbatch reshapes and stage-gated eval aren't wired for
-        # per-process feeding yet.
-        if pp > 1:
-            raise SystemExit("--pp with --multihost is not supported "
-                             "yet; use dp/dp×sp/ep/tp meshes on pods")
+        # root, each process writes its own shards).
         log.info(f"process {proc_index}/{proc_count}: multihost LM over "
                  f"{mesh}")
 
@@ -356,24 +360,28 @@ def main(argv=None):
                 step, mesh, seq_axis=SEQ_AXIS if ring else None, tp=tp > 1)
 
     val_on = args.val_frac > 0
-    if val_on and (pp > 1 or ep > 1):
-        raise SystemExit("--val_frac is not supported with --pp/--ep yet "
-                         "(their eval would need the pipelined/dispatched "
-                         "forward; train-loss tracking still works)")
     if val_on and args.val_every and args.val_every % args.print_freq:
         raise SystemExit(
             f"--val_every {args.val_every} must be a multiple of "
             f"--print_freq {args.print_freq} (validation rows ride the "
             "CSV print cadence)")
     eval_fn = None
-    if val_on:
+    if val_on and pp > 1:
+        from ..train.pp import build_pp_eval_step, shard_pp_eval_step
+
+        ev = build_pp_eval_step(model, alg)
+        eval_fn = shard_pp_eval_step(ev, mesh, pp_state_specs(state),
+                                     seq_axis=SEQ_AXIS if ring else None)
+    elif val_on:
         from ..train.lm import build_lm_eval_step, shard_lm_eval_step
 
         ev = build_lm_eval_step(model, alg,
-                                seq_axis=SEQ_AXIS if ring else None)
-        eval_fn = shard_lm_eval_step(ev, mesh,
-                                     seq_axis=SEQ_AXIS if ring else None,
-                                     tp=tp > 1)
+                                seq_axis=SEQ_AXIS if ring else None,
+                                ep_axis=EP_AXIS if ep > 1 else None)
+        eval_fn = shard_lm_eval_step(
+            ev, mesh, seq_axis=SEQ_AXIS if ring else None, tp=tp > 1,
+            state_specs=ep_state_specs(state) if ep > 1 else None,
+            ep_axis=EP_AXIS if ep > 1 else None)
 
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree.leaves(
@@ -390,11 +398,11 @@ def main(argv=None):
                                       host_local_slice, to_host)
     from ..utils.checkpoint import CheckpointManager
 
-    # ep/tp multihost states shard on non-leading dims — the rank-row
+    # ep/tp/pp multihost states shard on non-leading dims — the rank-row
     # msgpack slicing cannot represent them, but orbax's global-state mode
     # holds any sharding (every process writes its own shards of ONE
     # logical checkpoint)
-    orbax_global = proc_count > 1 and (ep > 1 or tp > 1)
+    orbax_global = proc_count > 1 and (ep > 1 or tp > 1 or pp > 1)
     if orbax_global:
         from ..utils.orbax_ckpt import OrbaxCheckpointManager
 
@@ -456,7 +464,7 @@ def main(argv=None):
     val_corpus = None
     if val_on:
         # hold out the corpus tail; at least one full validation batch
-        min_val = (args.seq_len + 1) * dp * args.batch_size
+        min_val = (args.seq_len + 1) * dp * ep * args.batch_size
         n_val = max(int(len(corpus) * args.val_frac), min_val)
         if n_val >= len(corpus) // 2:
             raise SystemExit("--val_frac leaves too little training data")
@@ -514,6 +522,26 @@ def main(argv=None):
 
     val_time = 0.0  # excluded from the throughput window (see below)
 
+    def shape_batch(arr):
+        """lm_batches yields ``[dp·ep, sp, b, block]``; rearrange for the
+        active mesh (shared by the train loop and validation so the two
+        paths can never disagree)."""
+        if pp > 1 and ring:
+            micro_b = args.batch_size // args.n_micro
+            return arr.reshape(dp, sp, args.n_micro, micro_b,
+                               args.seq_len // sp)
+        if pp > 1:
+            micro_b = args.batch_size // args.n_micro
+            return arr.reshape(dp, args.n_micro, micro_b, args.seq_len)
+        if ep > 1 and ring:
+            return arr.reshape(dp, ep, sp, args.batch_size,
+                               args.seq_len // sp)
+        if ep > 1:
+            return arr.reshape(dp, ep, args.batch_size, args.seq_len)
+        if not ring:
+            return arr.reshape(dp, args.batch_size, args.seq_len)
+        return arr
+
     def run_validation(st):
         """Mean held-out loss over --val_batches batches (≙ validate,
         gossip_sgd.py:440-471).
@@ -525,12 +553,10 @@ def main(argv=None):
         nonlocal val_time
         t_val = time.time()
         vals = []
-        for vt, vy in lm_batches(val_corpus, dp, sp, args.batch_size,
-                                 args.seq_len, seed=1):
-            if not ring:
-                vt = vt.reshape(dp, args.batch_size, args.seq_len)
-                vy = vy.reshape(dp, args.batch_size, args.seq_len)
-            m = eval_fn(st, globalize(vt), globalize(vy))
+        for vt, vy in lm_batches(val_corpus, dp * ep, sp,
+                                 args.batch_size, args.seq_len, seed=1):
+            m = eval_fn(st, globalize(shape_batch(vt)),
+                        globalize(shape_batch(vy)))
             if serialize:
                 jax.block_until_ready(m)
             vals.append(float(np.mean(host_metrics(m)["loss"])))
@@ -549,35 +575,8 @@ def main(argv=None):
             if skip_batches:
                 skip_batches -= 1
                 continue
-            if pp > 1 and ring:
-                # [dp, sp, b, block] → [dp, sp, M, mb, block]: the batch
-                # dim splits into microbatches inside each seq shard
-                micro_b = args.batch_size // args.n_micro
-                shape = (dp, sp, args.n_micro, micro_b,
-                         args.seq_len // sp)
-                tokens = tokens.reshape(shape)
-                targets = targets.reshape(shape)
-            elif pp > 1:
-                micro_b = args.batch_size // args.n_micro
-                tokens = tokens.reshape(dp, args.n_micro, micro_b,
-                                        args.seq_len)
-                targets = targets.reshape(dp, args.n_micro, micro_b,
-                                          args.seq_len)
-            elif ep > 1 and ring:
-                block = args.seq_len // sp
-                tokens = tokens.reshape(dp, ep, sp, args.batch_size, block)
-                targets = targets.reshape(dp, ep, sp, args.batch_size,
-                                          block)
-            elif ep > 1:
-                tokens = tokens.reshape(dp, ep, args.batch_size,
-                                        args.seq_len)
-                targets = targets.reshape(dp, ep, args.batch_size,
-                                          args.seq_len)
-            elif attn != "ring":
-                tokens = tokens.reshape(dp, args.batch_size, args.seq_len)
-                targets = targets.reshape(dp, args.batch_size, args.seq_len)
-            state, metrics = train_fn(state, globalize(tokens),
-                                      globalize(targets))
+            state, metrics = train_fn(state, globalize(shape_batch(tokens)),
+                                      globalize(shape_batch(targets)))
             if serialize:
                 jax.block_until_ready(state)
             steps_done += 1

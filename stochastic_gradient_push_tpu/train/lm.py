@@ -375,10 +375,11 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
 
 
 def build_lm_eval_step(model, algorithm: GossipAlgorithm,
-                       seq_axis: str | None = None) -> tp.Callable:
+                       seq_axis: str | None = None,
+                       ep_axis: str | None = None) -> tp.Callable:
     """Per-rank LM eval: de-biased params, no gossip, no state update
     (≙ ``validate``, gossip_sgd.py:440-471 — every rank evaluates
-    independently; only the seq mean is collective)."""
+    independently; only the seq/ep means are collective)."""
 
     def eval_step(state: TrainState, tokens, targets):
         z = algorithm.eval_params(state.params, state.gossip)
@@ -386,21 +387,32 @@ def build_lm_eval_step(model, algorithm: GossipAlgorithm,
         ce = lm_loss(logits, targets)
         if seq_axis is not None:
             ce = lax.pmean(ce, seq_axis)
+        if ep_axis is not None:
+            # ep shards evaluate their own held-out tokens (the ep axis
+            # doubles as data parallelism for eval, like training)
+            ce = lax.pmean(ce, ep_axis)
         return {"loss": ce, "ppl": jnp.exp(ce)}
 
     return eval_step
 
 
 def shard_lm_eval_step(eval_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
-                       seq_axis: str | None = SEQ_AXIS, tp: bool = False):
+                       seq_axis: str | None = SEQ_AXIS, tp: bool = False,
+                       state_specs=None, ep_axis: str | None = None):
     """Wrap an LM eval step for the mesh (mirrors
     :func:`shard_lm_train_step`, metrics only, no donation)."""
-    if seq_axis is None:
-        batch_spec = P(gossip_axis)
-        squeeze_n = 1
-    else:
+    if ep_axis is not None and seq_axis is not None:
+        batch_spec = P(gossip_axis, ep_axis, seq_axis)
+        squeeze_n = 3
+    elif ep_axis is not None:
+        batch_spec = P(gossip_axis, ep_axis)
+        squeeze_n = 2
+    elif seq_axis is not None:
         batch_spec = P(gossip_axis, seq_axis)
         squeeze_n = 2
+    else:
+        batch_spec = P(gossip_axis)
+        squeeze_n = 1
 
     def wrapped(state, tokens, targets):
         sq_state = jax.tree.map(lambda a: a[0], state)
@@ -411,11 +423,13 @@ def shard_lm_eval_step(eval_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
 
     kwargs = {}
     if tp:
-        kwargs["axis_names"] = {gossip_axis} | (
-            {seq_axis} if seq_axis else set())
+        kwargs["axis_names"] = {gossip_axis} \
+            | ({seq_axis} if seq_axis else set()) \
+            | ({ep_axis} if ep_axis else set())
+    state_spec = P(gossip_axis) if state_specs is None else state_specs
     sharded = jax.shard_map(
         wrapped, mesh=mesh,
-        in_specs=(P(gossip_axis), batch_spec, batch_spec),
+        in_specs=(state_spec, batch_spec, batch_spec),
         out_specs=P(gossip_axis), **kwargs)
     return jax.jit(sharded)
 

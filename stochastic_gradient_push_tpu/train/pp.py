@@ -48,7 +48,8 @@ PIPE_AXIS = "pipe"
 __all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "make_dp_pp_sp_mesh",
            "pp_state_specs",
            "init_pp_state", "pipeline_hidden", "pipeline_forward",
-           "build_pp_train_step", "shard_pp_train_step"]
+           "build_pp_train_step", "shard_pp_train_step",
+           "build_pp_eval_step", "shard_pp_eval_step"]
 
 
 def make_dp_pp_mesh(dp: int, pp: int, devices=None):
@@ -130,7 +131,7 @@ def _model_seq_axis(model) -> str | None:
 
 
 def pipeline_hidden(model, params, tokens: jnp.ndarray,
-                    pipe_axis: str = PIPE_AXIS) -> jnp.ndarray:
+                    pipe_axis: str = PIPE_AXIS, with_aux: bool = False):
     """Pipelined stack body: ``[M, b, t]`` tokens → ``[M, b, t, D]`` hidden
     states (valid on the last stage only).
 
@@ -143,6 +144,11 @@ def pipeline_hidden(model, params, tokens: jnp.ndarray,
     holds one contiguous block of every sequence; positions carry the
     block offset and the stage body's ring attention rotates KV over
     ``seq`` inside each tick.
+
+    With ``with_aux`` (MoE stages) the return is ``(hidden, aux)`` where
+    aux holds this stage's sown MoE scalars summed over its local layers
+    and valid ticks: ``load_balance`` (differentiable) and ``dropped``
+    (a metric); normalize by ``M · n_layers_total`` after a pipe psum.
     """
     seq_axis = _model_seq_axis(model)
     positions = jnp.arange(tokens.shape[-1])
@@ -158,11 +164,28 @@ def pipeline_hidden(model, params, tokens: jnp.ndarray,
 
     x = _stage_gated(stage == 0, embed_live, (pv, tv))
 
-    def body(h):
-        return model.apply({"params": params}, h, positions,
-                           method="blocks")
+    if not with_aux:
+        def body(h):
+            return model.apply({"params": params}, h, positions,
+                               method="blocks")
 
-    return pipeline_spmd(body, x, pipe_axis)
+        return pipeline_spmd(body, x, pipe_axis)
+
+    def body_aux(h):
+        out, mut = model.apply({"params": params}, h, positions,
+                               method="blocks",
+                               mutable=["losses", "moe_metrics"])
+        lb = jax.tree.leaves(mut.get("losses", {}))
+        dr = jax.tree.leaves(mut.get("moe_metrics", {}))
+        aux = {
+            "load_balance": (sum(jnp.sum(v) for v in lb) if lb
+                             else jnp.float32(0.0)),
+            "dropped": (sum(jnp.sum(v) for v in dr) if dr
+                        else jnp.float32(0.0)),
+        }
+        return out, aux
+
+    return pipeline_spmd(body_aux, x, pipe_axis, with_aux=True)
 
 
 def pipeline_forward(model, params, tokens: jnp.ndarray,
@@ -187,22 +210,33 @@ def pipeline_forward(model, params, tokens: jnp.ndarray,
 
 def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                         itr_per_epoch: int,
-                        pipe_axis: str = PIPE_AXIS) -> tp.Callable:
+                        pipe_axis: str = PIPE_AXIS,
+                        moe_loss_coef: float = 0.01) -> tp.Callable:
     """Per-rank pipelined LM step ``(state, tokens, targets) ->
     (state, metrics)``; same four-slot algorithm structure as every other
     step builder (train/step.py).  When the model's config carries a
     ``seq_axis`` the stage bodies run ring attention over the seq shards
-    (pp × sp) and gradients/metrics renormalize over seq."""
+    (pp × sp) and gradients/metrics renormalize over seq.  When it
+    carries ``moe_experts`` (MoE × pp, every layer an expert block) the
+    load-balance loss joins the objective and ``moe_dropped`` joins the
+    metrics — both computed per microbatch inside the tick schedule."""
     seq_axis = _model_seq_axis(model)
+    moe_on = getattr(getattr(model, "cfg", None), "moe_experts", 0) > 0
 
     def train_step(state: TrainState, tokens, targets):
         params, gstate = algorithm.pre_step(state.params, state.gossip)
         z = algorithm.eval_params(params, gstate)
         S = lax.axis_size(pipe_axis)
         stage = lax.axis_index(pipe_axis)
+        M = tokens.shape[0]
+        n_layers_total = model.n_local_layers * S
 
         def loss_fn(p):
-            hidden = pipeline_hidden(model, p, tokens, pipe_axis)
+            if moe_on:
+                hidden, aux = pipeline_hidden(model, p, tokens, pipe_axis,
+                                              with_aux=True)
+            else:
+                hidden = pipeline_hidden(model, p, tokens, pipe_axis)
             pv = _pipe_varying(p, pipe_axis)
             yv = pvary_missing(targets, (pipe_axis,))
 
@@ -218,17 +252,32 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             # over shards it equals the true loss): a psum here would
             # transpose into a second psum and scale every gradient by the
             # stage count
-            return _stage_gated(stage == S - 1, live, (pv, hidden, yv))
+            ce_masked = _stage_gated(stage == S - 1, live,
+                                     (pv, hidden, yv))
+            if not moe_on:
+                return ce_masked, (ce_masked, jnp.float32(0.0))
+            # per-shard MoE contributions: this stage's layers × its M
+            # valid ticks, normalized so the pipe psum yields the mean
+            # per layer per microbatch (the same psum trick as the CE)
+            denom = M * n_layers_total
+            lb = aux["load_balance"] / denom
+            total = ce_masked + moe_loss_coef * lb
+            return total, (ce_masked, aux["dropped"] / denom)
 
-        masked_loss, grads = jax.value_and_grad(loss_fn)(z)
-        # share the scalar for metrics only, after differentiation
+        (masked_loss, (masked_ce, masked_drop)), grads = \
+            jax.value_and_grad(loss_fn, has_aux=True)(z)
+        # share the scalars for metrics only, after differentiation
         loss = lax.psum(masked_loss, pipe_axis)
+        ce = lax.psum(masked_ce, pipe_axis)
+        dropped = lax.psum(masked_drop, pipe_axis)
         if seq_axis is not None:
             # params are seq-invariant → autodiff psums grads over the seq
             # shards' per-block CE; divide for the global token mean
             n_seq = lax.axis_size(seq_axis)
             grads = jax.tree.map(lambda g: g / n_seq, grads)
             loss = lax.pmean(loss, seq_axis)
+            ce = lax.pmean(ce, seq_axis)
+            dropped = lax.pmean(dropped, seq_axis)
         # no manual grad psum over pipe: replicated leaves (embed/head/ln_f)
         # are device-INVARIANT over pipe, so autodiff transposes their
         # implicit pvary into a psum — their grads arrive already summed
@@ -244,11 +293,71 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             lambda p, u: p - lr.astype(p.dtype) * u, params, updates)
         params, gstate = algorithm.post_step(params, gstate)
 
-        metrics = {"loss": loss, "ppl": jnp.exp(loss), "lr": lr}
+        # perplexity from the bare cross-entropy, not the MoE-augmented
+        # objective (mirrors build_lm_train_step)
+        metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr}
+        if moe_on:
+            metrics["moe_dropped"] = dropped
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 
     return train_step
+
+
+def build_pp_eval_step(model, algorithm: GossipAlgorithm,
+                       pipe_axis: str = PIPE_AXIS) -> tp.Callable:
+    """Per-rank pipelined LM eval: de-biased params through the tick
+    schedule, stage-gated head + CE, no state update (≙ ``validate``,
+    gossip_sgd.py:440-471).  Sown MoE aux is dropped (apply runs without
+    mutable collections, so ``sow`` is a no-op)."""
+    seq_axis = _model_seq_axis(model)
+
+    def eval_step(state: TrainState, tokens, targets):
+        z = algorithm.eval_params(state.params, state.gossip)
+        S = lax.axis_size(pipe_axis)
+        stage = lax.axis_index(pipe_axis)
+        hidden = pipeline_hidden(model, z, tokens, pipe_axis)
+        pv = _pipe_varying(z, pipe_axis)
+        yv = pvary_missing(targets, (pipe_axis,))
+
+        def live(ops):
+            q, h, y = ops
+            logits = model.apply({"params": q}, h, method="head")
+            return lm_loss(logits, y)
+
+        ce = lax.psum(
+            _stage_gated(stage == S - 1, live, (pv, hidden, yv)),
+            pipe_axis)
+        if seq_axis is not None:
+            ce = lax.pmean(ce, seq_axis)
+        return {"loss": ce, "ppl": jnp.exp(ce)}
+
+    return eval_step
+
+
+def shard_pp_eval_step(eval_fn, mesh, state_specs,
+                       gossip_axis: str = GOSSIP_AXIS,
+                       seq_axis: str | None = None):
+    """Wrap a pipelined eval step for the ``(gossip, pipe[, seq])`` mesh
+    (mirrors :func:`shard_pp_train_step`, metrics only, no donation)."""
+    if seq_axis is None:
+        batch_spec = P(gossip_axis)
+        squeeze_n = 1
+    else:
+        batch_spec = P(gossip_axis, seq_axis)
+        squeeze_n = 2
+
+    def wrapped(state, tokens, targets):
+        sq_state = jax.tree.map(lambda a: a[0], state)
+        sq = lambda t: t.reshape(t.shape[squeeze_n:])
+        metrics = eval_fn(sq_state, sq(tokens), sq(targets))
+        return jax.tree.map(lambda a: a[None], metrics)
+
+    sharded = jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec),
+        out_specs=P(gossip_axis))
+    return jax.jit(sharded)
 
 
 def shard_pp_train_step(step_fn, mesh, state_specs,

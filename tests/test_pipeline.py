@@ -33,15 +33,21 @@ def _cfg(n_layers, **kw):
 
 
 def _setup(dp, pp, n_layers, n_micro, micro_batch=2, algorithm=None,
-           momentum=0.0, remat=False):
-    cfg = _cfg(n_layers, remat=remat)
+           momentum=0.0, remat=False, moe=False, moe_loss_coef=0.01):
+    kw = dict(remat=remat)
+    if moe:
+        # capacity high enough that no token ever drops: per-microbatch
+        # routing then equals full-batch routing token-for-token
+        kw.update(moe_experts=4, moe_every=1, moe_capacity_factor=8.0)
+    cfg = _cfg(n_layers, **kw)
     model = PipelineStageLM(cfg, n_local_layers=n_layers // pp)
     mesh = make_dp_pp_mesh(dp, pp)
     alg = algorithm or all_reduce(GOSSIP_AXIS)
     tx = sgd(momentum=momentum, weight_decay=0.0)
     lrs = LRSchedule(ref_lr=0.1, batch_size=micro_batch * n_micro,
                      world_size=dp, decay_schedule={}, warmup=False)
-    step = build_pp_train_step(model, alg, tx, lrs, itr_per_epoch=100)
+    step = build_pp_train_step(model, alg, tx, lrs, itr_per_epoch=100,
+                               moe_loss_coef=moe_loss_coef)
     state = init_pp_state(model, mesh, alg, tx, dp=dp, pp=pp,
                           n_micro=n_micro, micro_batch=micro_batch,
                           seq_len=SEQ)
@@ -158,6 +164,34 @@ class TestPipelineParity:
         np.testing.assert_allclose(got.reshape(ref_logits.shape),
                                    ref_logits, rtol=2e-5, atol=2e-5)
 
+    def test_moe_pp_matches_stacked_model(self):
+        """MoE × pipeline (every layer an expert block, routed per
+        microbatch inside the ticks): with no-drop capacity, routing is
+        per-token, so CE and a momentum-free SGD step match the stacked
+        full-batch MoE model exactly (moe_loss_coef=0 isolates CE)."""
+        n_layers, pp, n_micro = 2, 2, 2
+        model, cfg, state, train_fn, toks, tgts = _setup(
+            1, pp, n_layers, n_micro, moe=True, moe_loss_coef=0.0)
+        ref_params = _assemble_reference_params(state, 0, n_layers)
+        ref_loss, ref_grads = _reference_loss_and_grads(
+            cfg, ref_params, toks[0], tgts[0])
+        new_state, metrics = train_fn(state, toks, tgts)
+        np.testing.assert_allclose(
+            float(np.asarray(metrics["loss"])[0]), float(ref_loss),
+            rtol=2e-5, atol=2e-5)
+        assert float(np.asarray(metrics["moe_dropped"])[0]) == 0.0
+
+        lr = float(np.asarray(metrics["lr"])[0])
+        new_ref = _assemble_reference_params(new_state, 0, n_layers)
+        expect = jax.tree.map(lambda p, g: p - lr * np.asarray(g),
+                              ref_params, ref_grads)
+        flat_e, _ = jax.tree_util.tree_flatten_with_path(expect)
+        flat_n, _ = jax.tree_util.tree_flatten_with_path(new_ref)
+        for (path_e, e), (_, n) in zip(flat_e, flat_n):
+            np.testing.assert_allclose(
+                np.asarray(n), np.asarray(e), rtol=5e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(path_e))
+
     def test_remat_matches(self):
         n_layers, pp, n_micro = 2, 2, 2
         _, _, state, train_fn, toks, tgts = _setup(1, pp, n_layers, n_micro)
@@ -220,9 +254,19 @@ class TestPipelineGossip:
         assert spread(state) < 1.0
 
     def test_fences(self):
-        """MoE × pipeline stays fenced (ring × pipeline was lifted in
-        round 3 — see TestPipelineRing)."""
-        cfg = _cfg(2, moe_experts=4, ep_axis="ep")
+        """pp × ep, MoE × pp with a non-uniform stack, and the
+        MoE-ring-pipeline triple stay fenced (ring × pipeline and
+        MoE × pipeline were lifted in round 3)."""
+        cfg = _cfg(2, moe_experts=4, moe_every=1, ep_axis="ep")
+        with pytest.raises(ValueError, match="fenced"):
+            PipelineStageLM(cfg, n_local_layers=1).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 2, SEQ), jnp.int32))
+        cfg = _cfg(2, moe_experts=4, moe_every=2)
+        with pytest.raises(ValueError, match="moe_every=1"):
+            PipelineStageLM(cfg, n_local_layers=1).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 2, SEQ), jnp.int32))
+        cfg = _cfg(2, moe_experts=4, moe_every=1, attn_impl="ring",
+                   seq_axis="seq")
         with pytest.raises(ValueError, match="fenced"):
             PipelineStageLM(cfg, n_local_layers=1).init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 2, SEQ), jnp.int32))
