@@ -32,6 +32,12 @@ probe() {
 
 echo "== probe =="
 KIND=$(probe) || { echo "TPU unreachable; aborting" | tee "$OUT/ABORTED"; exit 1; }
+case "$KIND" in
+  *[Cc]pu*|"")  # plugin failed to load and JAX fell back to host CPU:
+    echo "probe returned '$KIND' — not a TPU; aborting so CPU numbers" \
+         "never masquerade as TPU artifacts" | tee "$OUT/ABORTED"
+    exit 1;;
+esac
 echo "chip: $KIND" | tee "$OUT/chip.txt"
 
 echo "== 1/3 bench.py (headline) =="

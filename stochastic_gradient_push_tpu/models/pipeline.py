@@ -65,9 +65,6 @@ class PipelineStageLM(nn.Module):
                 "MoE × pipeline requires moe_every=1: the stage stack is "
                 "one uniform nn.scan, so every layer must share the block "
                 "structure — see ARCHITECTURE.md composition matrix")
-        if cfg.moe_experts > 0 and cfg.ep_axis is not None:
-            raise ValueError("pp × ep is fenced — see ARCHITECTURE.md "
-                             "composition matrix")
         if cfg.moe_experts > 0 and cfg.seq_axis is not None:
             raise ValueError("MoE × pipeline × sp is fenced — see "
                              "ARCHITECTURE.md composition matrix")

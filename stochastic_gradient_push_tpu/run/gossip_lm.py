@@ -167,13 +167,17 @@ def main(argv=None):
         # pipeline composes with gossip DP and — since round 3 — with
         # ring-attention sequence parallelism (the tick's ppermute moves
         # activations over pipe while ring attention rotates KV over seq:
-        # different manual axes, both uniform in the tick body) and with
+        # different manual axes, both uniform in the tick body), with
         # replicated-expert MoE (every layer an expert block, routed per
-        # microbatch inside the ticks).  ep's all_to_all dispatch inside
-        # a stage and tp remain fenced (ARCHITECTURE.md matrix).
-        if tp > 1 or ep > 1:
-            raise SystemExit("--pp composes with gossip DP, --sp and "
-                             "--moe_experts only (not --tp/--ep)")
+        # microbatch inside the ticks), and with expert parallelism
+        # (the MoE all_to_all dispatches token slots over ep inside each
+        # tick).  tp and the MoE-ring-pipeline triple remain fenced
+        # (ARCHITECTURE.md matrix).
+        if tp > 1:
+            raise SystemExit("--pp composes with gossip DP, --sp, "
+                             "--moe_experts and --ep only (not --tp)")
+        if ep > 1 and not args.moe_experts:
+            raise SystemExit("--pp with --ep requires --moe_experts > 0")
         if args.moe_experts:
             if args.moe_every != 1:
                 raise SystemExit("--pp with --moe_experts requires "
@@ -190,9 +194,6 @@ def main(argv=None):
         if args.batch_size % args.n_micro:
             raise SystemExit(f"batch_size {args.batch_size} not divisible "
                              f"by n_micro {args.n_micro}")
-    if ep > 1 and tp > 1 and sp > 1:
-        raise SystemExit("--ep × --tp × --sp (a 4-D mesh) is not "
-                         "supported; drop one axis")
     # --moe_experts with --sp > 1 (no ep): per-block routing — every
     # sequence shard routes its own block's tokens with per-block capacity;
     # expert weights are replicated over seq.  Routing is per-token, so
@@ -212,10 +213,18 @@ def main(argv=None):
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
     if pp > 1:
         from ..train.pp import (build_pp_train_step, init_pp_state,
-                                make_dp_pp_mesh, make_dp_pp_sp_mesh,
-                                pp_state_specs, shard_pp_train_step)
-        mesh = (make_dp_pp_sp_mesh(dp, pp, sp) if sp > 1
-                else make_dp_pp_mesh(dp, pp))
+                                make_dp_pp_ep_mesh, make_dp_pp_mesh,
+                                make_dp_pp_sp_mesh, pp_state_specs,
+                                shard_pp_train_step)
+        if sp > 1:
+            mesh = make_dp_pp_sp_mesh(dp, pp, sp)
+        elif ep > 1:
+            mesh = make_dp_pp_ep_mesh(dp, pp, ep)
+        else:
+            mesh = make_dp_pp_mesh(dp, pp)
+    elif ep > 1 and sp > 1 and tp > 1:
+        from ..train.lm import make_dp_ep_sp_tp_mesh
+        mesh = make_dp_ep_sp_tp_mesh(dp, ep, sp, tp)
     elif ep > 1 and sp > 1:
         mesh = make_dp_ep_sp_mesh(dp, ep, sp)
     elif ep > 1 and tp > 1:
@@ -244,6 +253,33 @@ def main(argv=None):
         # the pallas kernel needs the (clamped) 128 block to divide seq_len
         return seq_len % min(128, seq_len) == 0
 
+    def _flash_compiles() -> bool:
+        """Compile-and-run a tiny flash forward on the live backend.
+
+        The kernels' Mosaic lowering is only exercised on a real chip —
+        interpret-mode tests cannot catch layout rejections (round-2
+        lesson), so an auto-selected flash path probes once and falls
+        back to blockwise instead of stranding the whole run.  The probe
+        uses the RUN's dtype, head_dim, and (block-clamped) seq_len —
+        Mosaic layouts are shape/dtype-specific, so a fixed probe shape
+        could pass while the real model still fails."""
+        try:
+            from ..ops.flash_attention import flash_attention_forward
+
+            dtype = (jnp.bfloat16 if args.precision == "bf16"
+                     else jnp.float32)
+            head_dim = args.d_model // args.n_heads
+            t = min(128, args.seq_len)
+            x = jnp.zeros((1, 1, t, head_dim), dtype)
+            jax.block_until_ready(
+                flash_attention_forward(x, x, x, causal=True))
+            return True
+        except Exception as e:  # Mosaic/XLA compile or runtime rejection
+            log.warning(
+                f"flash-attention probe failed ({type(e).__name__}: "
+                f"{str(e)[:200]}); falling back to blockwise attention")
+            return False
+
     attn = args.attn
     if attn is None:
         attn = "ring" if sp > 1 else (
@@ -252,6 +288,9 @@ def main(argv=None):
             log.info(f"seq_len {args.seq_len} not divisible by the flash "
                      "kernel block; falling back to blockwise attention")
             attn = "blockwise"
+        elif attn == "flash" and not _flash_compiles():
+            attn = "blockwise"  # auto-selected only: explicit --attn
+            # flash lets the real error surface instead
     elif attn == "flash" and not _flash_ok(args.seq_len):
         raise SystemExit(
             f"--attn flash needs seq_len divisible by "
@@ -325,9 +364,12 @@ def main(argv=None):
         state = init_pp_state(model, mesh, alg, tx, dp=dp, pp=pp,
                               n_micro=args.n_micro,
                               micro_batch=args.batch_size // args.n_micro,
-                              seq_len=args.seq_len, seed=args.seed, sp=sp)
-        train_fn = shard_pp_train_step(step, mesh, pp_state_specs(state),
-                                       seq_axis=SEQ_AXIS if ring else None)
+                              seq_len=args.seq_len, seed=args.seed, sp=sp,
+                              ep=ep)
+        pp_ep = EP_AXIS if ep > 1 else None
+        train_fn = shard_pp_train_step(
+            step, mesh, pp_state_specs(state, ep_axis=pp_ep),
+            seq_axis=SEQ_AXIS if ring else None, ep_axis=pp_ep)
     else:
         step = build_lm_train_step(
             model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
@@ -369,9 +411,11 @@ def main(argv=None):
     if val_on and pp > 1:
         from ..train.pp import build_pp_eval_step, shard_pp_eval_step
 
+        pp_ep = EP_AXIS if ep > 1 else None
         ev = build_pp_eval_step(model, alg)
-        eval_fn = shard_pp_eval_step(ev, mesh, pp_state_specs(state),
-                                     seq_axis=SEQ_AXIS if ring else None)
+        eval_fn = shard_pp_eval_step(
+            ev, mesh, pp_state_specs(state, ep_axis=pp_ep),
+            seq_axis=SEQ_AXIS if ring else None, ep_axis=pp_ep)
     elif val_on:
         from ..train.lm import build_lm_eval_step, shard_lm_eval_step
 
@@ -530,6 +574,10 @@ def main(argv=None):
             micro_b = args.batch_size // args.n_micro
             return arr.reshape(dp, sp, args.n_micro, micro_b,
                                args.seq_len // sp)
+        if pp > 1 and ep > 1:
+            micro_b = args.batch_size // args.n_micro
+            return arr.reshape(dp, ep, args.n_micro, micro_b,
+                               args.seq_len)
         if pp > 1:
             micro_b = args.batch_size // args.n_micro
             return arr.reshape(dp, args.n_micro, micro_b, args.seq_len)

@@ -40,6 +40,7 @@ EP_AXIS = "ep"
 __all__ = ["SEQ_AXIS", "TP_AXIS", "EP_AXIS", "make_dp_sp_mesh",
            "make_dp_tp_mesh", "make_dp_sp_tp_mesh", "make_dp_ep_mesh",
            "make_dp_ep_sp_mesh", "make_dp_ep_tp_mesh",
+           "make_dp_ep_sp_tp_mesh",
            "build_lm_train_step", "shard_lm_train_step",
            "build_lm_eval_step", "shard_lm_eval_step",
            "shard_scanned_lm_step", "lm_loss",
@@ -101,6 +102,17 @@ def make_dp_ep_tp_mesh(dp: int, ep: int, tp: int, devices=None) -> Mesh:
                       devices)
 
 
+def make_dp_ep_sp_tp_mesh(dp: int, ep: int, sp: int, tp: int,
+                          devices=None) -> Mesh:
+    """4-D ``(gossip, ep, seq, tp)`` mesh: every parallelism axis at
+    once — gossip DP × expert dispatch × ring-attention sequence shards,
+    with GSPMD tensor parallelism on the auto ``tp`` axis inside each
+    (gossip, ep, seq) cell.  Same partial-manual recipe as ep × tp: the
+    manual collectives never mention tp."""
+    return _make_mesh((dp, ep, sp, tp),
+                      (GOSSIP_AXIS, EP_AXIS, SEQ_AXIS, TP_AXIS), devices)
+
+
 def make_dp_ep_sp_mesh(dp: int, ep: int, sp: int, devices=None) -> Mesh:
     """3-D ``(gossip, ep, seq)`` mesh: gossip × expert × ring-sequence
     parallelism.
@@ -113,6 +125,21 @@ def make_dp_ep_sp_mesh(dp: int, ep: int, sp: int, devices=None) -> Mesh:
     """
     return _make_mesh((dp, ep, sp), (GOSSIP_AXIS, EP_AXIS, SEQ_AXIS),
                       devices)
+
+
+def batch_layout(gossip_axis: str, seq_axis: str | None = None,
+                 ep_axis: str | None = None):
+    """``(PartitionSpec, n_leading_sharded_dims)`` for a token batch on
+    the given manual axes — the single source of truth for the batch
+    layout, shared by every shard_* wrapper (lm and pp, train and eval)
+    so the spec ladder cannot drift between them.  Dim order:
+    ``[gossip, ep?, seq?]``."""
+    axes = [gossip_axis]
+    if ep_axis is not None:
+        axes.append(ep_axis)
+    if seq_axis is not None:
+        axes.append(seq_axis)
+    return P(*axes), len(axes)
 
 
 def _is_expert_path(path) -> bool:
@@ -337,12 +364,7 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
     over tp according to the arrays' own shardings
     (see :func:`apply_tp_sharding`).
     """
-    if seq_axis is None:
-        batch_spec = P(gossip_axis)
-        squeeze_n = 1
-    else:
-        batch_spec = P(gossip_axis, seq_axis)
-        squeeze_n = 2
+    batch_spec, squeeze_n = batch_layout(gossip_axis, seq_axis, ep_axis)
 
     def wrapped(state, tokens, targets):
         sq_state = jax.tree.map(lambda a: a[0], state)
@@ -359,14 +381,6 @@ def shard_lm_train_step(step_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
             | ({ep_axis} if ep_axis else set())
         kwargs["axis_names"] = manual
     state_spec = P(gossip_axis) if state_specs is None else state_specs
-    if ep_axis is not None and seq_axis is not None:
-        # ep × sp: batches shard over (gossip, ep, seq)
-        batch_spec = P(gossip_axis, ep_axis, seq_axis)
-        squeeze_n = 3
-    elif ep_axis is not None:
-        # with expert parallelism, token batches shard over (gossip, ep)
-        batch_spec = P(gossip_axis, ep_axis)
-        squeeze_n = 2
     sharded = jax.shard_map(
         wrapped, mesh=mesh,
         in_specs=(state_spec, batch_spec, batch_spec),
@@ -401,18 +415,7 @@ def shard_lm_eval_step(eval_fn, mesh, gossip_axis: str = GOSSIP_AXIS,
                        state_specs=None, ep_axis: str | None = None):
     """Wrap an LM eval step for the mesh (mirrors
     :func:`shard_lm_train_step`, metrics only, no donation)."""
-    if ep_axis is not None and seq_axis is not None:
-        batch_spec = P(gossip_axis, ep_axis, seq_axis)
-        squeeze_n = 3
-    elif ep_axis is not None:
-        batch_spec = P(gossip_axis, ep_axis)
-        squeeze_n = 2
-    elif seq_axis is not None:
-        batch_spec = P(gossip_axis, seq_axis)
-        squeeze_n = 2
-    else:
-        batch_spec = P(gossip_axis)
-        squeeze_n = 1
+    batch_spec, squeeze_n = batch_layout(gossip_axis, seq_axis, ep_axis)
 
     def wrapped(state, tokens, targets):
         sq_state = jax.tree.map(lambda a: a[0], state)

@@ -46,6 +46,7 @@ from .state import TrainState
 PIPE_AXIS = "pipe"
 
 __all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "make_dp_pp_sp_mesh",
+           "make_dp_pp_ep_mesh",
            "pp_state_specs",
            "init_pp_state", "pipeline_hidden", "pipeline_forward",
            "build_pp_train_step", "shard_pp_train_step",
@@ -68,21 +69,42 @@ def make_dp_pp_sp_mesh(dp: int, pp: int, sp: int, devices=None):
                       devices)
 
 
+def make_dp_pp_ep_mesh(dp: int, pp: int, ep: int, devices=None):
+    """3-D ``(gossip, pipe, ep)`` mesh: pp × ep composition — the tick
+    schedule's ppermute moves activations over ``pipe`` while each MoE
+    block's all_to_all dispatches token slots over ``ep``; different
+    manual axes, both uniform in the tick body (bubble ticks dispatch
+    garbage slots that the aux/grad masking discards)."""
+    from .lm import EP_AXIS
+    return _make_mesh((dp, pp, ep), (GOSSIP_AXIS, PIPE_AXIS, EP_AXIS),
+                      devices)
+
+
 def _is_stage_path(path) -> bool:
     names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
     return any(n == "stack" for n in names)
 
 
 def pp_state_specs(state, gossip_axis: str = GOSSIP_AXIS,
-                   pipe_axis: str = PIPE_AXIS):
+                   pipe_axis: str = PIPE_AXIS,
+                   ep_axis: str | None = None):
     """Per-leaf PartitionSpecs for a pipeline-parallel LM state: stage
     stack leaves (params and their optimizer mirrors) shard
     ``(gossip, pipe)``, everything else replicates over pipe with
-    ``P(gossip)``.  Works on arrays or avals."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: (P(gossip_axis, pipe_axis)
-                            if _is_stage_path(path) else P(gossip_axis)),
-        state)
+    ``P(gossip)``.  With ``ep_axis`` (pp × ep), expert weights inside the
+    stack additionally shard their expert dim:
+    ``(gossip, pipe, ep)`` — globally ``[dp, L_total, E_total, ...]``.
+    Works on arrays or avals."""
+    from .lm import _is_expert_path
+
+    def spec_for(path, leaf):
+        if not _is_stage_path(path):
+            return P(gossip_axis)
+        if ep_axis is not None and _is_expert_path(path):
+            return P(gossip_axis, pipe_axis, ep_axis)
+        return P(gossip_axis, pipe_axis)
+
+    return jax.tree_util.tree_map_with_path(spec_for, state)
 
 
 # Stage-gating discipline (the ``lax.cond``s below): the predicate
@@ -222,6 +244,7 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
     metrics — both computed per microbatch inside the tick schedule."""
     seq_axis = _model_seq_axis(model)
     moe_on = getattr(getattr(model, "cfg", None), "moe_experts", 0) > 0
+    ep_axis = getattr(getattr(model, "cfg", None), "ep_axis", None)
 
     def train_step(state: TrainState, tokens, targets):
         params, gstate = algorithm.pre_step(state.params, state.gossip)
@@ -278,6 +301,20 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             loss = lax.pmean(loss, seq_axis)
             ce = lax.pmean(ce, seq_axis)
             dropped = lax.pmean(dropped, seq_axis)
+        if ep_axis is not None:
+            # pp × ep: replicated params are ep-invariant → autodiff psums
+            # their grads across the ep shards' different tokens; divide
+            # for the mean.  Expert slices inside the stack vary over
+            # (pipe, ep): their grads are shard-local (build_lm_train_step
+            # applies the same rule on the flat ep mesh)
+            from .lm import _is_expert_path
+            n_ep = lax.axis_size(ep_axis)
+            grads = jax.tree_util.tree_map_with_path(
+                lambda path, g: g if _is_expert_path(path) else g / n_ep,
+                grads)
+            loss = lax.pmean(loss, ep_axis)
+            ce = lax.pmean(ce, ep_axis)
+            dropped = lax.pmean(dropped, ep_axis)
         # no manual grad psum over pipe: replicated leaves (embed/head/ln_f)
         # are device-INVARIANT over pipe, so autodiff transposes their
         # implicit pvary into a psum — their grads arrive already summed
@@ -312,6 +349,8 @@ def build_pp_eval_step(model, algorithm: GossipAlgorithm,
     mutable collections, so ``sow`` is a no-op)."""
     seq_axis = _model_seq_axis(model)
 
+    ep_axis = getattr(getattr(model, "cfg", None), "ep_axis", None)
+
     def eval_step(state: TrainState, tokens, targets):
         z = algorithm.eval_params(state.params, state.gossip)
         S = lax.axis_size(pipe_axis)
@@ -330,6 +369,8 @@ def build_pp_eval_step(model, algorithm: GossipAlgorithm,
             pipe_axis)
         if seq_axis is not None:
             ce = lax.pmean(ce, seq_axis)
+        if ep_axis is not None:
+            ce = lax.pmean(ce, ep_axis)
         return {"loss": ce, "ppl": jnp.exp(ce)}
 
     return eval_step
@@ -337,15 +378,13 @@ def build_pp_eval_step(model, algorithm: GossipAlgorithm,
 
 def shard_pp_eval_step(eval_fn, mesh, state_specs,
                        gossip_axis: str = GOSSIP_AXIS,
-                       seq_axis: str | None = None):
-    """Wrap a pipelined eval step for the ``(gossip, pipe[, seq])`` mesh
-    (mirrors :func:`shard_pp_train_step`, metrics only, no donation)."""
-    if seq_axis is None:
-        batch_spec = P(gossip_axis)
-        squeeze_n = 1
-    else:
-        batch_spec = P(gossip_axis, seq_axis)
-        squeeze_n = 2
+                       seq_axis: str | None = None,
+                       ep_axis: str | None = None):
+    """Wrap a pipelined eval step for the ``(gossip, pipe[, seq|ep])``
+    mesh (mirrors :func:`shard_pp_train_step`, metrics only,
+    no donation)."""
+    from .lm import batch_layout
+    batch_spec, squeeze_n = batch_layout(gossip_axis, seq_axis, ep_axis)
 
     def wrapped(state, tokens, targets):
         sq_state = jax.tree.map(lambda a: a[0], state)
@@ -362,19 +401,17 @@ def shard_pp_eval_step(eval_fn, mesh, state_specs,
 
 def shard_pp_train_step(step_fn, mesh, state_specs,
                         gossip_axis: str = GOSSIP_AXIS,
-                        seq_axis: str | None = None):
-    """Wrap for the ``(gossip, pipe[, seq])`` mesh: state per
+                        seq_axis: str | None = None,
+                        ep_axis: str | None = None):
+    """Wrap for the ``(gossip, pipe[, seq|ep])`` mesh: state per
     ``state_specs`` (see :func:`pp_state_specs`); batches
     ``[dp, M, b, t]`` with ``P(gossip)`` (replicated over pipe) — or,
-    with ``seq_axis``, ``[dp, sp, M, b, block]`` with
-    ``P(gossip, seq)`` (the lm_batches block layout with the microbatch
-    split applied to the batch dim)."""
-    if seq_axis is None:
-        batch_spec = P(gossip_axis)
-        squeeze_n = 1
-    else:
-        batch_spec = P(gossip_axis, seq_axis)
-        squeeze_n = 2
+    with ``seq_axis``, ``[dp, sp, M, b, block]`` with ``P(gossip, seq)``
+    (the lm_batches block layout with the microbatch split applied to
+    the batch dim) — or, with ``ep_axis``, ``[dp, ep, M, b, t]`` with
+    ``P(gossip, ep)`` (each ep shard injects its own microbatches)."""
+    from .lm import batch_layout
+    batch_spec, squeeze_n = batch_layout(gossip_axis, seq_axis, ep_axis)
 
     def wrapped(state, tokens, targets):
         sq_state = jax.tree.map(lambda a: a[0], state)
@@ -392,58 +429,84 @@ def shard_pp_train_step(step_fn, mesh, state_specs,
 
 def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
                   n_micro: int, micro_batch: int, seq_len: int,
-                  seed: int = 0, sp: int = 1) -> TrainState:
+                  seed: int = 0, sp: int = 1, ep: int = 1) -> TrainState:
     """Initialize pipeline-parallel LM state on a ``(gossip, pipe)`` mesh
-    — or ``(gossip, pipe, seq)`` with ``sp > 1`` (pp × sp).
+    — or ``(gossip, pipe, seq)`` with ``sp > 1`` (pp × sp), or
+    ``(gossip, pipe, ep)`` with ``ep > 1`` (pp × ep).
 
     Parameter init runs under shard_map: every pipe shard draws its own
     stack slice with a pipe-index-folded RNG (so all ``L`` global layers
-    get independent draws), while replicated leaves use a common key and a
-    no-op ``pmean`` proves their pipe-invariance.  The whole state
+    get independent draws) — and with ``ep`` the expert weights inside
+    the stack fold the ep index too, so every GLOBAL (layer, expert) cell
+    is an independent draw — while replicated leaves use a common key and
+    a no-op ``pmean`` proves their pipe-invariance.  The whole state
     materializes straight into its per-leaf shardings — no full-model
     replica ever exists on one device.
     """
     from jax.sharding import NamedSharding
 
-    from .lm import SEQ_AXIS
+    from .lm import EP_AXIS, SEQ_AXIS, _is_expert_path
     from .step import replicate_state
 
     ring = sp > 1
     block = seq_len // sp
-    lead = 2 if ring else 1  # leading sharded batch dims to strip
+    ep_ax = EP_AXIS if ep > 1 else None
+    lead = 2 if (ring or ep > 1) else 1  # leading batch dims to strip
 
     def init_fn(toks):
         t = toks.reshape(toks.shape[lead:])  # → [M, b, block]
-        common = model.init(jax.random.PRNGKey(seed), t)["params"]
-        local = model.init(
-            jax.random.fold_in(jax.random.PRNGKey(seed),
-                               lax.axis_index(PIPE_AXIS)),
-            t)["params"]
+        key = jax.random.PRNGKey(seed)
+        pipe_key = jax.random.fold_in(key, lax.axis_index(PIPE_AXIS))
+        common = model.init(key, t)["params"]
+        local = model.init(pipe_key, t)["params"]
+        if ep_ax is not None:
+            local_ep = model.init(
+                jax.random.fold_in(pipe_key, lax.axis_index(ep_ax)),
+                t)["params"]
+        else:
+            local_ep = local
+
+        def pick(path, c, l, le):
+            if not _is_stage_path(path):
+                return lax.pmean(c, PIPE_AXIS)
+            if ep_ax is not None and _is_expert_path(path):
+                return le
+            return l
+
         params = jax.tree_util.tree_map_with_path(
-            lambda path, c, l: l if _is_stage_path(path)
-            else lax.pmean(c, PIPE_AXIS),
-            common, local)
+            pick, common, local, local_ep)
         return jax.tree.map(lambda a: a[None], params)
 
-    # param STRUCTURE (paths only): with ring attention the live model
-    # references the seq axis, so probe an axis-free twin of the config
+    # param STRUCTURE (paths only): with ring attention or ep the live
+    # model references mesh axes, so probe an axis-free twin of the config
     probe_model = model
-    if getattr(model.cfg, "seq_axis", None) is not None:
+    if getattr(model.cfg, "seq_axis", None) is not None or \
+            getattr(model.cfg, "ep_axis", None) is not None:
         probe_model = type(model)(
-            model.cfg._replace(seq_axis=None, attn_impl="full"),
+            model.cfg._replace(seq_axis=None, attn_impl="full",
+                               ep_axis=None),
             n_local_layers=model.n_local_layers)
     probe = jax.eval_shape(
         lambda: probe_model.init(jax.random.PRNGKey(seed),
                                  jnp.zeros((n_micro, micro_batch, block),
                                            jnp.int32)))
-    param_specs = pp_state_specs(probe["params"])
+    param_specs = pp_state_specs(probe["params"], ep_axis=ep_ax)
 
-    in_spec = P(GOSSIP_AXIS, SEQ_AXIS) if ring else P(GOSSIP_AXIS)
+    if ring:
+        in_spec = P(GOSSIP_AXIS, SEQ_AXIS)
+    elif ep > 1:
+        in_spec = P(GOSSIP_AXIS, EP_AXIS)
+    else:
+        in_spec = P(GOSSIP_AXIS)
     sm_init = jax.shard_map(init_fn, mesh=mesh,
                             in_specs=(in_spec,),
                             out_specs=param_specs)
-    dummy_shape = ((dp, sp, n_micro, micro_batch, block) if ring
-                   else (dp, n_micro, micro_batch, seq_len))
+    if ring:
+        dummy_shape = (dp, sp, n_micro, micro_batch, block)
+    elif ep > 1:
+        dummy_shape = (dp, ep, n_micro, micro_batch, seq_len)
+    else:
+        dummy_shape = (dp, n_micro, micro_batch, seq_len)
     dummy = np.zeros(dummy_shape, np.int32)
 
     def build(d):
@@ -456,7 +519,7 @@ def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
             gossip=replicate_state(algorithm.init(one(params)), dp))
 
     shapes = jax.eval_shape(build, dummy)
-    specs = pp_state_specs(shapes)
+    specs = pp_state_specs(shapes, ep_axis=ep_ax)
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P))
     return jax.jit(build, out_shardings=shardings)(dummy)

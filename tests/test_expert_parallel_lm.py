@@ -100,12 +100,29 @@ def test_composition_fences_raise_clean_errors():
     from stochastic_gradient_push_tpu.run.gossip_lm import main
 
     base = ["--world_size", "8", "--moe_experts", "4", "--num_steps", "1"]
-    with pytest.raises(SystemExit, match="4-D mesh"):
-        main(base + ["--ep", "2", "--tp", "2", "--sp", "2"])
     with pytest.raises(SystemExit, match="requires --moe_experts"):
         main(["--world_size", "8", "--ep", "2", "--num_steps", "1"])
     with pytest.raises(SystemExit, match="needs --sp"):
         main(base + ["--ep", "2", "--attn", "ring"])
+
+
+def test_moe_ep_sp_tp_4d_trains(tmp_path):
+    """All four axes at once: gossip × ep × seq × tp on one 4-D mesh,
+    with held-out validation through the same composed forward."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    r = main(["--world_size", "8", "--ep", "2", "--sp", "2", "--tp", "2",
+              "--moe_experts", "4", "--moe_every", "2",
+              "--seq_len", "32", "--d_model", "32", "--n_layers", "2",
+              "--n_heads", "4", "--d_ff", "64", "--vocab_size", "64",
+              "--batch_size", "4", "--num_steps", "4",
+              "--corpus_tokens", "40000", "--print_freq", "2",
+              "--val_frac", "0.1", "--val_every", "2",
+              "--val_batches", "2", "--checkpoint_dir", str(tmp_path)])
+    assert np.isfinite(r["final_loss"])
+    assert np.isfinite(r["val_loss"])
 
 
 def test_moe_with_ring_sp_trains(tmp_path):
@@ -186,6 +203,25 @@ def test_moe_pp_trains(tmp_path):
               "2", "--checkpoint_dir", str(tmp_path)])
     assert np.isfinite(r["final_loss"])
     # the pipelined eval path (stage-gated head) produced a real value
+    assert np.isfinite(r["val_loss"])
+
+
+def test_moe_pp_ep_trains(tmp_path):
+    """pp × ep through the CLI: expert-sharded dispatch (all_to_all over
+    ep) inside the pipeline tick schedule, with held-out validation."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    r = main(["--world_size", "8", "--pp", "2", "--ep", "2",
+              "--n_micro", "2", "--moe_experts", "4", "--moe_every", "1",
+              "--seq_len", "32", "--d_model", "32", "--n_layers", "2",
+              "--n_heads", "4", "--d_ff", "32", "--vocab_size", "32",
+              "--batch_size", "4", "--num_steps", "4",
+              "--corpus_tokens", "40000", "--print_freq", "2",
+              "--val_frac", "0.1", "--val_every", "2", "--val_batches",
+              "2", "--checkpoint_dir", str(tmp_path)])
+    assert np.isfinite(r["final_loss"])
     assert np.isfinite(r["val_loss"])
 
 
