@@ -17,11 +17,12 @@ composes (pp × sp): the tick's ppermute moves activations over ``pipe``
 while each block's ring rotation moves KV over ``seq`` — different manual
 axes, both uniform collectives inside the scanned tick body, so they
 nest cleanly (tests/test_pipeline.py pins parity with the stacked ring
-model).  Replicated-expert MoE composes too (``moe_every=1`` so the
-scanned stack stays uniform; tokens route per microbatch inside the
-ticks).  Still fenced (composition matrix, ARCHITECTURE.md): pp × ep —
-expert-sharded dispatch would need its all_to_all inside a stage — and
-the MoE × pipeline × sp triple.
+model).  MoE composes too (``moe_every=1`` so the scanned stack stays
+uniform; tokens route per microbatch inside the ticks) — replicated
+experts, expert-sharded dispatch over an ``ep`` axis (the all_to_all is
+uniform across ticks), and per-block routing under ``seq`` sharding.
+The one remaining fence (composition matrix, ARCHITECTURE.md) is the
+4-D pp × ep × sp triple.
 """
 
 from __future__ import annotations
@@ -65,9 +66,11 @@ class PipelineStageLM(nn.Module):
                 "MoE × pipeline requires moe_every=1: the stage stack is "
                 "one uniform nn.scan, so every layer must share the block "
                 "structure — see ARCHITECTURE.md composition matrix")
-        if cfg.moe_experts > 0 and cfg.seq_axis is not None:
-            raise ValueError("MoE × pipeline × sp is fenced — see "
-                             "ARCHITECTURE.md composition matrix")
+        if cfg.moe_experts > 0 and cfg.ep_axis is not None \
+                and cfg.seq_axis is not None:
+            raise ValueError("pp × ep × sp (a 4-D pipeline mesh) is "
+                             "fenced — see ARCHITECTURE.md composition "
+                             "matrix")
         self.embed = nn.Embed(cfg.vocab_size, cfg.d_model,
                               embedding_init=nn.initializers.normal(0.02),
                               dtype=cfg.dtype)

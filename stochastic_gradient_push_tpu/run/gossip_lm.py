@@ -168,11 +168,11 @@ def main(argv=None):
         # ring-attention sequence parallelism (the tick's ppermute moves
         # activations over pipe while ring attention rotates KV over seq:
         # different manual axes, both uniform in the tick body), with
-        # replicated-expert MoE (every layer an expert block, routed per
-        # microbatch inside the ticks), and with expert parallelism
-        # (the MoE all_to_all dispatches token slots over ep inside each
-        # tick).  tp and the MoE-ring-pipeline triple remain fenced
-        # (ARCHITECTURE.md matrix).
+        # MoE (every layer an expert block, routed per microbatch inside
+        # the ticks — per-block when seq-sharded), and with expert
+        # parallelism (the MoE all_to_all dispatches token slots over ep
+        # inside each tick).  tp and the 4-D pp × ep × sp triple remain
+        # fenced (ARCHITECTURE.md matrix).
         if tp > 1:
             raise SystemExit("--pp composes with gossip DP, --sp, "
                              "--moe_experts and --ep only (not --tp)")
@@ -183,9 +183,9 @@ def main(argv=None):
                 raise SystemExit("--pp with --moe_experts requires "
                                  "--moe_every 1 (the stage stack is one "
                                  "uniform scan)")
-            if sp > 1:
-                raise SystemExit("--pp × --sp × --moe_experts is not "
-                                 "supported; drop one axis")
+            if sp > 1 and ep > 1:
+                raise SystemExit("--pp × --sp × --ep (a 4-D pipeline "
+                                 "mesh) is not supported; drop one axis")
         if args.n_micro < 1:
             raise SystemExit(f"--n_micro must be >= 1 (got {args.n_micro})")
         if args.n_layers % pp:

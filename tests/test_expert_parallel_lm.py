@@ -225,6 +225,23 @@ def test_moe_pp_ep_trains(tmp_path):
     assert np.isfinite(r["val_loss"])
 
 
+def test_moe_pp_sp_trains(tmp_path):
+    """MoE × pp × sp through the CLI: per-block expert routing inside the
+    ring-attention pipeline ticks."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    r = main(["--world_size", "8", "--pp", "2", "--sp", "2",
+              "--n_micro", "2", "--moe_experts", "4", "--moe_every", "1",
+              "--seq_len", "32", "--d_model", "32", "--n_layers", "2",
+              "--n_heads", "4", "--d_ff", "32", "--vocab_size", "32",
+              "--batch_size", "4", "--num_steps", "3",
+              "--corpus_tokens", "20000", "--print_freq", "3",
+              "--checkpoint_dir", str(tmp_path)])
+    assert np.isfinite(r["final_loss"])
+
+
 def test_moe_ep_with_ring_sp_trains(tmp_path):
     """ep x sp: expert parallelism (all_to_all over ep) composed with
     ring sequence parallelism on the 3-D (gossip, ep, seq) mesh."""

@@ -252,6 +252,55 @@ class TestPipelineExpert:
                                        rtol=2e-5, atol=2e-5)
 
 
+    def test_pp_sp_moe_eval_matches_assembled_model(self):
+        """MoE × pp × sp: per-block expert routing (no collectives when
+        ep is off) inside the ring-attention pipeline ticks.  Under
+        no-drop capacity, per-(tick, block) routing equals full-batch
+        routing and ring equals full attention, so the composed eval CE
+        matches a stacked full-attention full-batch MoE model."""
+        from stochastic_gradient_push_tpu.train.lm import lm_loss
+        from stochastic_gradient_push_tpu.train.pp import (
+            build_pp_eval_step, init_pp_state, make_dp_pp_sp_mesh,
+            pp_state_specs, shard_pp_eval_step)
+
+        dp, pp, sp, n_layers, n_micro, mb = 2, 2, 2, 2, 2, 2
+        block = SEQ // sp
+        cfg = _cfg(n_layers, moe_experts=4, moe_every=1,
+                   moe_capacity_factor=8.0, attn_impl="ring",
+                   seq_axis="seq")
+        model = PipelineStageLM(cfg, n_local_layers=n_layers // pp)
+        mesh = make_dp_pp_sp_mesh(dp, pp, sp)
+        alg = all_reduce(GOSSIP_AXIS)
+        tx = sgd(momentum=0.0, weight_decay=0.0)
+        state = init_pp_state(model, mesh, alg, tx, dp=dp, pp=pp,
+                              n_micro=n_micro, micro_batch=mb,
+                              seq_len=SEQ, sp=sp)
+        eval_fn = shard_pp_eval_step(
+            build_pp_eval_step(model, alg), mesh,
+            pp_state_specs(state), seq_axis="seq")
+        rng = np.random.default_rng(4)
+        shape = (dp, sp, n_micro, mb, block)
+        toks = rng.integers(0, VOCAB, size=shape).astype(np.int32)
+        tgts = rng.integers(0, VOCAB, size=shape).astype(np.int32)
+        got = np.asarray(eval_fn(state, toks, tgts)["loss"])
+
+        ref_model = TransformerLM(cfg._replace(
+            attn_impl="full", seq_axis=None, remat=False))
+        for r in range(dp):
+            ref_params = _assemble_reference_params(state, r, n_layers)
+            # reassemble full sequences from the contiguous seq blocks
+            full_t = np.concatenate(
+                [toks[r, j] for j in range(sp)], axis=-1
+            ).reshape(-1, SEQ)
+            full_y = np.concatenate(
+                [tgts[r, j] for j in range(sp)], axis=-1
+            ).reshape(-1, SEQ)
+            ref_ce = float(lm_loss(
+                ref_model.apply({"params": ref_params}, full_t), full_y))
+            np.testing.assert_allclose(float(got[r]), ref_ce,
+                                       rtol=2e-5, atol=2e-5)
+
+
 class TestPipelineGossip:
     @pytest.mark.parametrize("make_alg", [
         lambda dp: sgp(build_schedule(
@@ -302,15 +351,15 @@ class TestPipelineGossip:
         assert spread(state) < 1.0
 
     def test_fences(self):
-        """MoE × pp with a non-uniform stack and the MoE-ring-pipeline
-        triple stay fenced (ring × pipeline, MoE × pipeline, and
-        pp × ep were all lifted in round 3)."""
+        """MoE × pp with a non-uniform stack and the 4-D pp × ep × sp
+        triple stay fenced (ring × pipeline, MoE × pipeline, pp × ep,
+        and MoE × pp × sp were all lifted in round 3)."""
         cfg = _cfg(2, moe_experts=4, moe_every=2)
         with pytest.raises(ValueError, match="moe_every=1"):
             PipelineStageLM(cfg, n_local_layers=1).init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 2, SEQ), jnp.int32))
         cfg = _cfg(2, moe_experts=4, moe_every=1, attn_impl="ring",
-                   seq_axis="seq")
+                   seq_axis="seq", ep_axis="ep")
         with pytest.raises(ValueError, match="fenced"):
             PipelineStageLM(cfg, n_local_layers=1).init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 2, SEQ), jnp.int32))
