@@ -96,6 +96,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_every", default=0, type=int,
                    help="checkpoint every N steps (0 = only at the end)")
     p.add_argument("--resume", default="False", type=str)
+    p.add_argument("--ckpt_backend", default="msgpack",
+                   choices=["msgpack", "orbax"],
+                   help="checkpoint backend (same as gossip_sgd): "
+                        "self-contained msgpack, or orbax (async saves, "
+                        "retention GC; on pods one shared jax.Array-"
+                        "native checkpoint).  ep/tp/pp multihost meshes "
+                        "force orbax regardless — their state shards on "
+                        "non-leading dims")
+    p.add_argument("--heartbeat_timeout", default=300, type=int,
+                   help="log an error if a blocking metrics fetch stalls "
+                        "longer than this many seconds (a dead peer host "
+                        "shows up as a hung collective; ≙ the 300s "
+                        "gossip-flag timeout, distributed.py:36); 0 "
+                        "disables")
     p.add_argument("--val_frac", default=0.0, type=float,
                    help="hold out this fraction of the corpus tail for "
                         "validation (0 = off); val_loss/val_ppl columns "
@@ -445,9 +459,12 @@ def main(argv=None):
     # ep/tp/pp multihost states shard on non-leading dims — the rank-row
     # msgpack slicing cannot represent them, but orbax's global-state mode
     # holds any sharding (every process writes its own shards of ONE
-    # logical checkpoint)
-    orbax_global = proc_count > 1 and (ep > 1 or tp > 1 or pp > 1)
-    if orbax_global:
+    # logical checkpoint).  --ckpt_backend orbax selects the same backend
+    # voluntarily (async saves + retention GC single-process)
+    use_orbax = (args.ckpt_backend == "orbax"
+                 or (proc_count > 1 and (ep > 1 or tp > 1 or pp > 1)))
+    orbax_global = use_orbax and proc_count > 1
+    if use_orbax:
         from ..utils.orbax_ckpt import OrbaxCheckpointManager
 
         ckpt = OrbaxCheckpointManager(args.checkpoint_dir, tag=args.tag,
@@ -497,7 +514,9 @@ def main(argv=None):
                 "tokens_per_sec": 0.0, "already_complete": True}
 
     def save_ckpt(st, step):
-        if orbax_global:
+        if use_orbax:
+            # orbax steps are keyed by id: pass the step explicitly (the
+            # live sharded state on pods, host conversion single-process)
             ckpt.save(st, {"step": step}, epoch_id=step)
         else:
             ckpt.save(host_local_slice(st) if proc_count > 1 else st,
@@ -521,9 +540,22 @@ def main(argv=None):
     moe_on = args.moe_experts > 0
     if not (start_step and os.path.isfile(out_fname)):
         with open(out_fname, "w") as f:
-            print("step,loss,ppl,lr,tokens_per_sec"
+            print("step,loss,ppl,lr,tokens_per_sec,grad_norm"
                   + (",moe_dropped" if moe_on else "")
                   + (",val_loss,val_ppl" if val_on else ""), file=f)
+
+    # heartbeat around the blocking metrics fetch (≙ the reference's 300s
+    # gossip-flag timeout): a dead peer host shows up as a hung collective
+    # at the next host readback, and silence is the worst failure mode.
+    # Armed only from the second print point on — the first fetch drains
+    # the queued compile, which can legitimately exceed any sane timeout.
+    import contextlib
+
+    from ..utils.profiling import StepWatchdog
+    watchdog = (StepWatchdog(timeout=args.heartbeat_timeout,
+                             rank=proc_index)
+                if args.heartbeat_timeout > 0 else None)
+    prints_done = 0
 
     loss_meter = Meter(ptag="Loss")
     steps_done = start_step
@@ -638,7 +670,12 @@ def main(argv=None):
                     jax.profiler.stop_trace()
                     prof_stopped = True
             if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
-                mh = host_metrics(metrics)
+                guard = (watchdog.step()
+                         if watchdog is not None and prints_done >= 1
+                         else contextlib.nullcontext())
+                with guard:
+                    mh = host_metrics(metrics)
+                prints_done += 1
                 loss = float(np.mean(mh["loss"]))
                 loss_meter.update(loss)
                 tps = (tokens_per_step * (steps_done - start_step)
@@ -646,7 +683,8 @@ def main(argv=None):
                 row = (f"{steps_done},{loss:.4f},"
                        f"{float(np.mean(mh['ppl'])):.2f},"
                        f"{float(np.mean(mh['lr'])):.5f},"
-                       f"{tps:.0f}")
+                       f"{tps:.0f},"
+                       f"{float(np.mean(mh['grad_norm'])):.4f}")
                 if moe_on:
                     row += (",%.4f" % float(np.mean(mh['moe_dropped'])))
                 if val_on:
@@ -669,8 +707,8 @@ def main(argv=None):
         epoch += 1
     if last_saved != steps_done:
         save_ckpt(state, steps_done)
-    if orbax_global:
-        ckpt.wait()
+    if use_orbax:
+        ckpt.wait()  # async saves must land before exit
         ckpt.close()
     if prof_started and not prof_stopped:
         jax.profiler.stop_trace()

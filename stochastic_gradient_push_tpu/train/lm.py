@@ -342,9 +342,17 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         params, gstate = algorithm.post_step(params, gstate)
 
         # perplexity from the bare cross-entropy, not the MoE-augmented
-        # objective; moe_dropped makes capacity overflow observable
+        # objective; moe_dropped makes capacity overflow observable;
+        # grad_norm (utils/flatten.py) for divergence triage — averaged
+        # over seq/ep shards (expert grads are shard-local, so the raw
+        # norm varies over ep and would break the metrics' replication)
+        from ..utils.flatten import global_norm
+        gn = global_norm(grads)
+        for ax in (seq_axis, ep_axis):
+            if ax is not None:
+                gn = lax.pmean(gn, ax)
         metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr,
-                   "moe_dropped": dropped}
+                   "moe_dropped": dropped, "grad_norm": gn}
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 

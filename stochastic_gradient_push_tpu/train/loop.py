@@ -506,6 +506,14 @@ class Trainer:
                 itr = i + j
                 if itr % cfg.print_freq == 0:
                     self._log_row(epoch, itr, meters, stat_meters)
+                    if cfg.verbose:
+                        # grad-norm observability rides the stdout log —
+                        # the CSV schema stays byte-compatible with the
+                        # reference
+                        gn = float(metric_slices["grad_norm"][:, j].mean())
+                        self.log.info(
+                            f"epoch {epoch} itr {itr}: "
+                            f"grad_norm {gn:.4f}")
 
         it = iter(loader)
         i = start_itr - 1
@@ -581,6 +589,7 @@ class Trainer:
                 "loss": to_arr(metrics["loss"]),
                 "top1": to_arr(metrics["top1"]),
                 "top5": to_arr(metrics["top5"]),
+                "grad_norm": to_arr(metrics["grad_norm"]),
             }
             elapsed_nn = time.time() - nn_time
             elapsed_batch = time.time() - batch_time

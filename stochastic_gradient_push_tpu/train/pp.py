@@ -331,8 +331,16 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         params, gstate = algorithm.post_step(params, gstate)
 
         # perplexity from the bare cross-entropy, not the MoE-augmented
-        # objective (mirrors build_lm_train_step)
-        metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr}
+        # objective; grad_norm for divergence triage — averaged over
+        # pipe (stack grads are stage-local) and any seq/ep shards so
+        # the metric stays replication-safe (mirrors build_lm_train_step)
+        from ..utils.flatten import global_norm
+        gn = lax.pmean(global_norm(grads), pipe_axis)
+        for ax in (seq_axis, ep_axis):
+            if ax is not None:
+                gn = lax.pmean(gn, ax)
+        metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr,
+                   "grad_norm": gn}
         if moe_on:
             metrics["moe_dropped"] = dropped
         return state.replace(step=state.step + 1, params=params,

@@ -141,7 +141,11 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
 
         params, gstate = algorithm.post_step(params, gstate)
 
-        metrics = {"loss": loss, "top1": top1, "top5": top5, "lr": lr}
+        # grad-norm observability (the reference logs none; handy for
+        # divergence triage) — one reduce over the raveled grads
+        from ..utils.flatten import global_norm
+        metrics = {"loss": loss, "top1": top1, "top5": top5, "lr": lr,
+                   "grad_norm": global_norm(grads)}
         if local_axis is not None:
             metrics = jax.tree.map(
                 lambda m: lax.pmean(m, local_axis), metrics)

@@ -65,9 +65,16 @@ def communicate(tree, communication_op):
 
 
 def global_norm(tree) -> jnp.ndarray:
-    """L2 norm over all leaves (handy for gossip-disagreement metrics)."""
-    flat, _ = ravel_pytree(tree)
-    return jnp.linalg.norm(flat)
+    """L2 norm over all leaves (feeds the per-step ``grad_norm`` metric).
+
+    Per-leaf sum-of-squares, not ``ravel_pytree``: the ravel would
+    materialize a flat copy of the whole tree every step just to reduce
+    it."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
 
 
 def is_power_of(n: int, k: int) -> bool:

@@ -143,7 +143,8 @@ def plot_transformer(fpaths: dict[str, str], out_path: str | None = None):
 
 def parse_lm_csv(fpath: str) -> "pd.DataFrame":
     """Parse an LM harness CSV (run/gossip_lm.py: header
-    ``step,loss,ppl,lr,tokens_per_sec[,moe_dropped][,val_loss,val_ppl]``).
+    ``step,loss,ppl,lr,tokens_per_sec,grad_norm[,moe_dropped]
+    [,val_loss,val_ppl]``).
 
     The reference had no in-repo LM harness (its transformer runs lived in
     an external fairseq fork, parsed by :func:`parse_transformer_out`);

@@ -156,7 +156,10 @@ def test_cli_lm_ring_sp(tmp_path):
     csv = tmp_path / "lm_out_n8.csv"
     assert csv.exists()
     assert csv.read_text().splitlines()[0] == \
-        "step,loss,ppl,lr,tokens_per_sec"
+        "step,loss,ppl,lr,tokens_per_sec,grad_norm"
+    # the grad_norm column carries real values on every training row
+    assert all(float(l.split(",")[5]) > 0
+               for l in csv.read_text().splitlines()[1:])
 
 
 def test_cli_rejects_inconsistent_flags(tmp_path):

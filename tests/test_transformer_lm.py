@@ -180,6 +180,34 @@ def test_lm_cli_checkpoint_and_resume(tmp_path):
     assert 4 in steps and 8 in steps
 
 
+def test_lm_cli_orbax_backend_save_and_resume(tmp_path):
+    """--ckpt_backend orbax through the LM CLI: per-step orbax saves with
+    retention, then resume from the latest step."""
+    import os
+
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    base = ["--world_size", "8", "--seq_len", "32", "--d_model", "32",
+            "--n_layers", "1", "--n_heads", "4", "--d_ff", "32",
+            "--vocab_size", "32", "--batch_size", "2",
+            "--corpus_tokens", "20000", "--print_freq", "2",
+            "--ckpt_backend", "orbax", "--checkpoint_dir", str(tmp_path)]
+    r1 = main(base + ["--num_steps", "4"])
+    assert np.isfinite(r1["final_loss"])
+    root = tmp_path / "lm_orbax_r0_n8"
+    assert root.is_dir(), f"missing orbax root under {os.listdir(tmp_path)}"
+    assert any(d.name == "4" for d in root.iterdir()), \
+        "no step-4 orbax checkpoint"
+
+    r2 = main(base + ["--num_steps", "8", "--resume", "True"])
+    assert np.isfinite(r2["final_loss"])
+    csv = (tmp_path / "lm_out_n8.csv").read_text().splitlines()
+    steps = [int(l.split(",")[0]) for l in csv[1:]]
+    assert 4 in steps and 8 in steps
+
+
 def test_scanned_lm_step_matches_sequential():
     """shard_scanned_lm_step(n) produces the same state and per-step losses
     as n individual dispatches, for plain dp and dp x sp (ring) layouts."""
