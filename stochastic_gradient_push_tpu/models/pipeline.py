@@ -20,9 +20,10 @@ nest cleanly (tests/test_pipeline.py pins parity with the stacked ring
 model).  MoE composes too (``moe_every=1`` so the scanned stack stays
 uniform; tokens route per microbatch inside the ticks) — replicated
 experts, expert-sharded dispatch over an ``ep`` axis (the all_to_all is
-uniform across ticks), and per-block routing under ``seq`` sharding.
-The one remaining fence (composition matrix, ARCHITECTURE.md) is the
-4-D pp × ep × sp triple.
+uniform across ticks), per-block routing under ``seq`` sharding, and
+the full 4-D pp × ep × sp mesh.  The only constraint left is
+structural: MoE requires ``moe_every=1`` (composition matrix,
+ARCHITECTURE.md).
 """
 
 from __future__ import annotations
@@ -66,11 +67,6 @@ class PipelineStageLM(nn.Module):
                 "MoE × pipeline requires moe_every=1: the stage stack is "
                 "one uniform nn.scan, so every layer must share the block "
                 "structure — see ARCHITECTURE.md composition matrix")
-        if cfg.moe_experts > 0 and cfg.ep_axis is not None \
-                and cfg.seq_axis is not None:
-            raise ValueError("pp × ep × sp (a 4-D pipeline mesh) is "
-                             "fenced — see ARCHITECTURE.md composition "
-                             "matrix")
         self.embed = nn.Embed(cfg.vocab_size, cfg.d_model,
                               embedding_init=nn.initializers.normal(0.02),
                               dtype=cfg.dtype)

@@ -183,23 +183,19 @@ def main(argv=None):
         # activations over pipe while ring attention rotates KV over seq:
         # different manual axes, both uniform in the tick body), with
         # MoE (every layer an expert block, routed per microbatch inside
-        # the ticks — per-block when seq-sharded), and with expert
+        # the ticks — per-block when seq-sharded), with expert
         # parallelism (the MoE all_to_all dispatches token slots over ep
-        # inside each tick).  tp and the 4-D pp × ep × sp triple remain
-        # fenced (ARCHITECTURE.md matrix).
+        # inside each tick), and with the full 4-D pp × ep × sp mesh.
+        # Only tp stays fenced (ARCHITECTURE.md matrix).
         if tp > 1:
             raise SystemExit("--pp composes with gossip DP, --sp, "
                              "--moe_experts and --ep only (not --tp)")
         if ep > 1 and not args.moe_experts:
             raise SystemExit("--pp with --ep requires --moe_experts > 0")
-        if args.moe_experts:
-            if args.moe_every != 1:
-                raise SystemExit("--pp with --moe_experts requires "
-                                 "--moe_every 1 (the stage stack is one "
-                                 "uniform scan)")
-            if sp > 1 and ep > 1:
-                raise SystemExit("--pp × --sp × --ep (a 4-D pipeline "
-                                 "mesh) is not supported; drop one axis")
+        if args.moe_experts and args.moe_every != 1:
+            raise SystemExit("--pp with --moe_experts requires "
+                             "--moe_every 1 (the stage stack is one "
+                             "uniform scan)")
         if args.n_micro < 1:
             raise SystemExit(f"--n_micro must be >= 1 (got {args.n_micro})")
         if args.n_layers % pp:
@@ -227,10 +223,12 @@ def main(argv=None):
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
     if pp > 1:
         from ..train.pp import (build_pp_train_step, init_pp_state,
-                                make_dp_pp_ep_mesh, make_dp_pp_mesh,
-                                make_dp_pp_sp_mesh, pp_state_specs,
-                                shard_pp_train_step)
-        if sp > 1:
+                                make_dp_pp_ep_mesh, make_dp_pp_ep_sp_mesh,
+                                make_dp_pp_mesh, make_dp_pp_sp_mesh,
+                                pp_state_specs, shard_pp_train_step)
+        if sp > 1 and ep > 1:
+            mesh = make_dp_pp_ep_sp_mesh(dp, pp, ep, sp)
+        elif sp > 1:
             mesh = make_dp_pp_sp_mesh(dp, pp, sp)
         elif ep > 1:
             mesh = make_dp_pp_ep_mesh(dp, pp, ep)
@@ -601,26 +599,16 @@ def main(argv=None):
     def shape_batch(arr):
         """lm_batches yields ``[dp·ep, sp, b, block]``; rearrange for the
         active mesh (shared by the train loop and validation so the two
-        paths can never disagree)."""
-        if pp > 1 and ring:
-            micro_b = args.batch_size // args.n_micro
-            return arr.reshape(dp, sp, args.n_micro, micro_b,
-                               args.seq_len // sp)
-        if pp > 1 and ep > 1:
-            micro_b = args.batch_size // args.n_micro
-            return arr.reshape(dp, ep, args.n_micro, micro_b,
-                               args.seq_len)
+        paths can never disagree).  One compositional shape — leading
+        sharded dims ``[dp, ep?, sp?]`` (the batch_layout order), then
+        the microbatch split for pipeline runs — covers every mesh."""
+        block = args.seq_len // sp
+        lead = (dp,) + ((ep,) if ep > 1 else ()) + ((sp,) if ring else ())
         if pp > 1:
-            micro_b = args.batch_size // args.n_micro
-            return arr.reshape(dp, args.n_micro, micro_b, args.seq_len)
-        if ep > 1 and ring:
-            return arr.reshape(dp, ep, sp, args.batch_size,
-                               args.seq_len // sp)
-        if ep > 1:
-            return arr.reshape(dp, ep, args.batch_size, args.seq_len)
-        if not ring:
-            return arr.reshape(dp, args.batch_size, args.seq_len)
-        return arr
+            tail = (args.n_micro, args.batch_size // args.n_micro, block)
+        else:
+            tail = (args.batch_size, block)
+        return arr.reshape(lead + tail)
 
     def run_validation(st):
         """Mean held-out loss over --val_batches batches (≙ validate,

@@ -46,7 +46,7 @@ from .state import TrainState
 PIPE_AXIS = "pipe"
 
 __all__ = ["PIPE_AXIS", "make_dp_pp_mesh", "make_dp_pp_sp_mesh",
-           "make_dp_pp_ep_mesh",
+           "make_dp_pp_ep_mesh", "make_dp_pp_ep_sp_mesh",
            "pp_state_specs",
            "init_pp_state", "pipeline_hidden", "pipeline_forward",
            "build_pp_train_step", "shard_pp_train_step",
@@ -66,6 +66,19 @@ def make_dp_pp_sp_mesh(dp: int, pp: int, sp: int, devices=None):
     axes, so the two collectives nest cleanly in the scanned tick body."""
     from .lm import SEQ_AXIS
     return _make_mesh((dp, pp, sp), (GOSSIP_AXIS, PIPE_AXIS, SEQ_AXIS),
+                      devices)
+
+
+def make_dp_pp_ep_sp_mesh(dp: int, pp: int, ep: int, sp: int,
+                          devices=None):
+    """4-D ``(gossip, pipe, ep, seq)`` mesh: the full pipeline
+    composition — ticks ppermute activations over ``pipe``, each MoE
+    block all_to_alls token slots over ``ep`` within its seq shard, and
+    ring attention rotates KV over ``seq``.  Three manual collectives on
+    three different axes, all uniform in the scanned tick body."""
+    from .lm import EP_AXIS, SEQ_AXIS
+    return _make_mesh((dp, pp, ep, sp),
+                      (GOSSIP_AXIS, PIPE_AXIS, EP_AXIS, SEQ_AXIS),
                       devices)
 
 
@@ -459,7 +472,8 @@ def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
     ring = sp > 1
     block = seq_len // sp
     ep_ax = EP_AXIS if ep > 1 else None
-    lead = 2 if (ring or ep > 1) else 1  # leading batch dims to strip
+    # leading sharded batch dims to strip: [gossip, ep?, seq?]
+    lead = 1 + (ep > 1) + ring
 
     def init_fn(toks):
         t = toks.reshape(toks.shape[lead:])  # → [M, b, block]
@@ -500,21 +514,15 @@ def init_pp_state(model, mesh, algorithm, tx, dp: int, pp: int,
                                            jnp.int32)))
     param_specs = pp_state_specs(probe["params"], ep_axis=ep_ax)
 
-    if ring:
-        in_spec = P(GOSSIP_AXIS, SEQ_AXIS)
-    elif ep > 1:
-        in_spec = P(GOSSIP_AXIS, EP_AXIS)
-    else:
-        in_spec = P(GOSSIP_AXIS)
+    from .lm import batch_layout
+    in_spec, _ = batch_layout(GOSSIP_AXIS,
+                              SEQ_AXIS if ring else None, ep_ax)
     sm_init = jax.shard_map(init_fn, mesh=mesh,
                             in_specs=(in_spec,),
                             out_specs=param_specs)
-    if ring:
-        dummy_shape = (dp, sp, n_micro, micro_batch, block)
-    elif ep > 1:
-        dummy_shape = (dp, ep, n_micro, micro_batch, seq_len)
-    else:
-        dummy_shape = (dp, n_micro, micro_batch, seq_len)
+    dummy_shape = ((dp,) + ((ep,) if ep > 1 else ())
+                   + ((sp,) if ring else ())
+                   + (n_micro, micro_batch, block))
     dummy = np.zeros(dummy_shape, np.int32)
 
     def build(d):

@@ -242,6 +242,25 @@ def test_moe_pp_sp_trains(tmp_path):
     assert np.isfinite(r["final_loss"])
 
 
+def test_moe_pp_ep_sp_4d_trains(tmp_path):
+    """The 4-D pipeline mesh through the CLI: gossip × pipe × ep × seq
+    with validation through the same composed forward."""
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+    r = main(["--world_size", "8", "--pp", "2", "--ep", "2", "--sp", "2",
+              "--n_micro", "2", "--moe_experts", "4", "--moe_every", "1",
+              "--seq_len", "32", "--d_model", "32", "--n_layers", "2",
+              "--n_heads", "4", "--d_ff", "32", "--vocab_size", "64",
+              "--batch_size", "4", "--num_steps", "3",
+              "--corpus_tokens", "40000", "--print_freq", "3",
+              "--val_frac", "0.1", "--val_every", "3",
+              "--val_batches", "2", "--checkpoint_dir", str(tmp_path)])
+    assert np.isfinite(r["final_loss"])
+    assert np.isfinite(r["val_loss"])
+
+
 def test_moe_ep_with_ring_sp_trains(tmp_path):
     """ep x sp: expert parallelism (all_to_all over ep) composed with
     ring sequence parallelism on the 3-D (gossip, ep, seq) mesh."""
