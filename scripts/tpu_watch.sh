@@ -1,0 +1,22 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~4 min; the moment it answers, run
+# scripts/tpu_window.sh (captures bench + flash + LM artifacts) and exit.
+# Gives up after ~11 h so the round can end cleanly.
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+DEADLINE=$(( $(date +%s) + 11*3600 ))
+N=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  N=$((N+1))
+  KIND=$(timeout 75 python -c "import jax; d=jax.devices(); print(d[0].device_kind, len(d))" 2>/dev/null)
+  case "$KIND" in
+    *[Cc]pu*|"") echo "[$(date -u +%H:%M:%S)] probe $N: tunnel down ('$KIND')";;
+    *) echo "[$(date -u +%H:%M:%S)] probe $N: ALIVE: $KIND — firing tpu_window.sh"
+       bash "$REPO/scripts/tpu_window.sh"
+       exit $? ;;
+  esac
+  sleep 240
+done
+echo "watch deadline reached without a TPU window"
+exit 1

@@ -536,11 +536,31 @@ def main(argv=None):
         f"{args.tag}out_n{world}.csv" if proc_count == 1
         else f"{args.tag}out_p{proc_index}_n{world}.csv")
     moe_on = args.moe_experts > 0
-    if not (start_step and os.path.isfile(out_fname)):
-        with open(out_fname, "w") as f:
-            print("step,loss,ppl,lr,tokens_per_sec,grad_norm"
+    csv_header = ("step,loss,ppl,lr,tokens_per_sec,grad_norm"
                   + (",moe_dropped" if moe_on else "")
-                  + (",val_loss,val_ppl" if val_on else ""), file=f)
+                  + (",val_loss,val_ppl" if val_on else ""))
+    if start_step and os.path.isfile(out_fname):
+        # appending to a pre-existing CSV: the schema has grown over time
+        # (grad_norm column), so a resume of an old run could silently
+        # misalign rows against the stale header — rewrite it in place
+        with open(out_fname) as f:
+            old_lines = f.read().splitlines()
+        if old_lines and old_lines[0] != csv_header:
+            log.warning(
+                "existing CSV header %r != current schema %r; rewriting "
+                "header (old rows keep their original column count)",
+                old_lines[0], csv_header)
+            # write-then-rename: a crash mid-rewrite must not destroy
+            # the run's accumulated loss history
+            tmp = out_fname + ".tmp"
+            with open(tmp, "w") as f:
+                print(csv_header, file=f)
+                for row in old_lines[1:]:
+                    print(row, file=f)
+            os.replace(tmp, out_fname)
+    else:
+        with open(out_fname, "w") as f:
+            print(csv_header, file=f)
 
     # heartbeat around the blocking metrics fetch (≙ the reference's 300s
     # gossip-flag timeout): a dead peer host shows up as a hung collective

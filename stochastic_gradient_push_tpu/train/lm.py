@@ -80,9 +80,11 @@ def make_dp_ep_mesh(dp: int, ep: int, devices=None) -> Mesh:
     """2-D ``(gossip, ep)`` mesh: gossip replicas × expert parallelism.
 
     The ep axis doubles as extra data parallelism for the non-MoE
-    sublayers: each ep shard carries its own tokens, and replicated-
-    parameter gradients are exactly averaged over ep (like the
-    hierarchical local axis) while expert slices stay shard-local.
+    sublayers: each ep shard carries its own tokens, and ALL gradients
+    — replicated leaves and expert slices alike — are exactly averaged
+    over ep (like the hierarchical local axis); expert PARAMS are
+    sharded over ep, but every shard's tokens contribute to every
+    expert's gradient through the all_to_all.
     """
     return _make_mesh((dp, ep), (GOSSIP_AXIS, EP_AXIS), devices)
 
@@ -286,8 +288,10 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
     token-mean cross-entropy, and with sequence sharding the seq-psummed
     gradients are renormalized to the global token mean.  With
     ``ep_axis``, MoE load-balance losses (sown by the model) join the
-    objective, replicated-parameter gradients are renormalized over the
-    ep shards, and expert-slice gradients stay shard-local.
+    objective and ALL gradients are renormalized by the ep shard count —
+    expert slices included, since the all_to_all transpose accumulates
+    every shard's contribution into them exactly as the implicit psum
+    does for replicated leaves.
     """
 
     def train_step(state: TrainState, tokens, targets):
@@ -321,13 +325,18 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             ce = lax.pmean(ce, seq_axis)
             dropped = lax.pmean(dropped, seq_axis)
         if ep_axis is not None:
-            # replicated params are invariant over ep → autodiff psums
-            # their grads across the ep shards' different tokens; divide
-            # for the mean.  Expert slices vary over ep: grads are local.
+            # the objective is the MEAN over ep shards of per-shard loss.
+            # Replicated params are ep-invariant → autodiff psums their
+            # grads across shards; expert slices live on one shard each,
+            # but the all_to_all transpose accumulates every shard's
+            # cotangents into them just the same (each expert processes
+            # slots from ALL shards).  Both arrive as the SUM over shards
+            # → divide everything by n_ep for the mean.  (Exempting
+            # expert slices would train them with an effective n_ep× lr;
+            # pinned by test_expert_parallel_lm.py::
+            # test_ep_train_step_matches_full_expert_model.)
             n_ep = lax.axis_size(ep_axis)
-            grads = jax.tree_util.tree_map_with_path(
-                lambda path, g: g if _is_expert_path(path) else g / n_ep,
-                grads)
+            grads = jax.tree.map(lambda g: g / n_ep, grads)
             loss = lax.pmean(loss, ep_axis)
             ce = lax.pmean(ce, ep_axis)
             dropped = lax.pmean(dropped, ep_axis)
@@ -344,8 +353,9 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         # perplexity from the bare cross-entropy, not the MoE-augmented
         # objective; moe_dropped makes capacity overflow observable;
         # grad_norm (utils/flatten.py) for divergence triage — averaged
-        # over seq/ep shards (expert grads are shard-local, so the raw
-        # norm varies over ep and would break the metrics' replication)
+        # over seq/ep shards (each shard's expert-slice VALUES differ —
+        # different experts live there — so the raw norm varies over ep
+        # and would break the metrics' replication)
         from ..utils.flatten import global_norm
         gn = global_norm(grads)
         for ax in (seq_axis, ep_axis):

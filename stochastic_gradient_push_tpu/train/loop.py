@@ -506,10 +506,12 @@ class Trainer:
                 itr = i + j
                 if itr % cfg.print_freq == 0:
                     self._log_row(epoch, itr, meters, stat_meters)
-                    if cfg.verbose:
+                    if cfg.verbose and metric_slices.get("grad_norm") \
+                            is not None:
                         # grad-norm observability rides the stdout log —
                         # the CSV schema stays byte-compatible with the
-                        # reference
+                        # reference; step functions not built by
+                        # build_train_step may omit the key entirely
                         gn = float(metric_slices["grad_norm"][:, j].mean())
                         self.log.info(
                             f"epoch {epoch} itr {itr}: "
@@ -589,7 +591,8 @@ class Trainer:
                 "loss": to_arr(metrics["loss"]),
                 "top1": to_arr(metrics["top1"]),
                 "top5": to_arr(metrics["top5"]),
-                "grad_norm": to_arr(metrics["grad_norm"]),
+                "grad_norm": (to_arr(metrics["grad_norm"])
+                              if "grad_norm" in metrics else None),
             }
             elapsed_nn = time.time() - nn_time
             elapsed_batch = time.time() - batch_time

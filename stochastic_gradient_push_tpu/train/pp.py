@@ -315,16 +315,15 @@ def build_pp_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             ce = lax.pmean(ce, seq_axis)
             dropped = lax.pmean(dropped, seq_axis)
         if ep_axis is not None:
-            # pp × ep: replicated params are ep-invariant → autodiff psums
-            # their grads across the ep shards' different tokens; divide
-            # for the mean.  Expert slices inside the stack vary over
-            # (pipe, ep): their grads are shard-local (build_lm_train_step
-            # applies the same rule on the flat ep mesh)
-            from .lm import _is_expert_path
+            # pp × ep: the objective is the MEAN over ep shards.  Every
+            # grad arrives as the SUM over shards — replicated leaves via
+            # the implicit psum, expert slices via the all_to_all
+            # transpose (each expert processes slots from ALL shards) —
+            # so divide uniformly by n_ep (build_lm_train_step applies
+            # the same rule on the flat ep mesh; pinned by
+            # test_pipeline.py::test_pp_ep_train_matches_assembled_model)
             n_ep = lax.axis_size(ep_axis)
-            grads = jax.tree_util.tree_map_with_path(
-                lambda path, g: g if _is_expert_path(path) else g / n_ep,
-                grads)
+            grads = jax.tree.map(lambda g: g / n_ep, grads)
             loss = lax.pmean(loss, ep_axis)
             ce = lax.pmean(ce, ep_axis)
             dropped = lax.pmean(dropped, ep_axis)

@@ -92,6 +92,74 @@ def test_moe_lm_trains_with_gossip_and_ep():
     assert np.all(np.isfinite(r)) and np.abs(r).max() > 0
 
 
+def test_ep_train_step_matches_full_expert_model():
+    """One momentum-free SGD step on the (gossip=1, ep=2) mesh moves every
+    param — expert slices included — by exactly ``-lr * grad`` of the
+    stacked full-expert model under the mean-over-ep-shards CE.
+
+    Pins the uniform ``/n_ep`` gradient scaling: expert grads arrive as
+    the SUM over shards via the all_to_all transpose (each expert
+    processes slots from every shard), so exempting them from the
+    division — as round 3 did — trains experts with an effective
+    ``n_ep``× learning rate while every loss/eval metric looks fine.
+    """
+    import jax.numpy as jnp
+
+    from stochastic_gradient_push_tpu.algorithms import all_reduce
+    from stochastic_gradient_push_tpu.train.lm import lm_loss
+
+    dp, ep = 1, 2
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=D, n_layers=LAYERS, n_heads=HEADS,
+        d_ff=FF, max_len=SEQ, attn_impl="full",
+        moe_experts=4, moe_every=2, moe_capacity_factor=8.0,
+        ep_axis=EP_AXIS)
+    model = TransformerLM(cfg)
+    mesh = make_dp_ep_mesh(dp, ep)
+    alg = all_reduce(GOSSIP_AXIS)
+    tx = sgd(momentum=0.0, weight_decay=0.0)
+    lrs = LRSchedule(ref_lr=0.1, batch_size=BATCH, world_size=dp * ep,
+                     decay_schedule={}, warmup=False)
+    step = build_lm_train_step(model, alg, tx, lrs, itr_per_epoch=100,
+                               seq_axis=None, ep_axis=EP_AXIS,
+                               moe_loss_coef=0.0)
+    state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
+                             batch_size=BATCH, seq_len=SEQ)
+    train_fn = shard_lm_train_step(step, mesh, seq_axis=None,
+                                   state_specs=ep_state_specs(state),
+                                   ep_axis=EP_AXIS)
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, VOCAB,
+                        size=(dp, ep, BATCH, SEQ)).astype(np.int32)
+    tgts = rng.integers(0, VOCAB,
+                        size=(dp, ep, BATCH, SEQ)).astype(np.int32)
+
+    # rank-0 slice of the global state: expert dims are already global
+    ref_params = jax.tree.map(lambda a: np.asarray(a)[0], state.params)
+    ref_model = TransformerLM(cfg._replace(ep_axis=None))
+
+    def ref_loss(p):
+        ces = []
+        for j in range(ep):
+            logits = ref_model.apply({"params": p}, toks[0, j])
+            ces.append(lm_loss(logits, tgts[0, j]))
+        return jnp.mean(jnp.stack(ces))
+
+    ref_grads = jax.grad(ref_loss)(ref_params)
+    new_state, metrics = train_fn(state, toks, tgts)
+    assert float(np.asarray(metrics["moe_dropped"])[0]) == 0.0
+    lr = float(np.asarray(metrics["lr"])[0])
+    new_ref = jax.tree.map(lambda a: np.asarray(a)[0], new_state.params)
+    expect = jax.tree.map(lambda p, g: p - lr * np.asarray(g),
+                          ref_params, ref_grads)
+    flat_e, _ = jax.tree_util.tree_flatten_with_path(expect)
+    flat_n, _ = jax.tree_util.tree_flatten_with_path(new_ref)
+    for (path_e, e), (_, n) in zip(flat_e, flat_n):
+        np.testing.assert_allclose(
+            np.asarray(n), np.asarray(e), rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path_e))
+
+
 def test_composition_fences_raise_clean_errors():
     """Unsupported parallelism compositions fail at the CLI boundary with
     actionable messages (ARCHITECTURE.md composition matrix)."""
