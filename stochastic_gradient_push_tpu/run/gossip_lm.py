@@ -547,16 +547,23 @@ def main(argv=None):
             old_lines = f.read().splitlines()
         if old_lines and old_lines[0] != csv_header:
             log.warning(
-                "existing CSV header %r != current schema %r; rewriting "
-                "header (old rows keep their original column count)",
+                "existing CSV header %r != current schema %r; remapping "
+                "old rows to the new schema (missing columns left empty)",
                 old_lines[0], csv_header)
+            old_cols = old_lines[0].split(",")
+            new_cols = csv_header.split(",")
             # write-then-rename: a crash mid-rewrite must not destroy
             # the run's accumulated loss history
             tmp = out_fname + ".tmp"
             with open(tmp, "w") as f:
                 print(csv_header, file=f)
                 for row in old_lines[1:]:
-                    print(row, file=f)
+                    # re-seat each value under its original column name so
+                    # e.g. val_loss never lands in a newly inserted
+                    # grad_norm slot
+                    vals = dict(zip(old_cols, row.split(",")))
+                    print(",".join(vals.get(c, "") for c in new_cols),
+                          file=f)
             os.replace(tmp, out_fname)
     else:
         with open(out_fname, "w") as f:

@@ -3,8 +3,12 @@
 from .plotting import (
     ITERATIONS_PER_EPOCH,
     parse_csv,
+    parse_epochs,
+    parse_lm_csv,
     parse_transformer_out,
+    plot_error_vs_time,
     plot_itrs,
+    plot_lm,
     plot_scaling,
     plot_transformer,
 )
@@ -12,8 +16,12 @@ from .plotting import (
 __all__ = [
     "ITERATIONS_PER_EPOCH",
     "parse_csv",
+    "parse_epochs",
+    "parse_lm_csv",
     "parse_transformer_out",
+    "plot_error_vs_time",
     "plot_itrs",
+    "plot_lm",
     "plot_scaling",
     "plot_transformer",
 ]

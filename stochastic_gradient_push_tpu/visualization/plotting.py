@@ -17,8 +17,9 @@ import re
 
 import pandas as pd
 
-__all__ = ["parse_csv", "parse_lm_csv", "parse_transformer_out",
-           "plot_itrs", "plot_lm", "plot_scaling", "plot_transformer",
+__all__ = ["parse_csv", "parse_epochs", "parse_lm_csv",
+           "parse_transformer_out", "plot_error_vs_time", "plot_itrs",
+           "plot_lm", "plot_scaling", "plot_transformer",
            "ITERATIONS_PER_EPOCH"]
 
 # iterations per epoch at batch 256/node on ImageNet
@@ -51,6 +52,67 @@ def parse_csv(fpath: str) -> tuple[pd.DataFrame, pd.DataFrame]:
     train["elapsed"] = train["avg:BT(s)"] * (
         train["Epoch"] * itr_per_epoch + train["itr"] + 1)
     return train, val
+
+
+def parse_epochs(directory: str, world_size: int,
+                 tag: str = "") -> pd.DataFrame:
+    """Per-epoch cross-rank summary for the error-vs-time figures
+    (≙ plotting.py:195-228 ``parse_csv``): one row per epoch with
+
+    - ``train_mean``: 100 − mean over ranks of the end-of-epoch
+      ``avg:Prec@1`` (the epoch's cumulative training accuracy),
+    - ``val_mean``: mean over ranks of the validation rows' top-1 error,
+    - ``time``: elapsed seconds — epoch-end global iteration × the mean
+      cumulative batch time (the reference's estimate, plotting.py:226),
+    - ``itr``: cumulative iteration count at each epoch end.
+    """
+    frames, itr_per_epoch = [], 0
+    for f in _gather_rank_files(directory, world_size, tag):
+        train, val = parse_csv(f)
+        # last logged row of each epoch carries the cumulative epoch stats
+        ends = train.groupby("Epoch").tail(1).set_index("Epoch")
+        frame = pd.DataFrame({"train_mean": 100 - ends["avg:Prec@1"],
+                              "time_mean": ends["avg:BT(s)"]})
+        if len(val):
+            # align on Epoch, not position: a run killed mid-epoch has an
+            # epoch-end train row without a matching validation row
+            frame["val_mean"] = 100 - val.set_index("Epoch")["val"]
+        frames.append(frame)
+        itr_per_epoch = max(itr_per_epoch, train["itr"].max() + 1)
+    if not frames:
+        raise FileNotFoundError(
+            f"no {tag}out_r*_n{world_size}.csv under {directory}")
+    # cross-rank mean per epoch (NaN-skipping, so ranks with fewer logged
+    # epochs or missing validation rows average over what exists)
+    pdf = pd.concat(frames).groupby(level=0).mean()
+    pdf["itr"] = (pdf.index + 1) * itr_per_epoch
+    pdf["time"] = pdf["itr"] * pdf["time_mean"].iloc[-1]
+    return pdf.reset_index()
+
+
+def plot_error_vs_time(runs: dict[str, str], world_size: int,
+                       tag: str = "", val: bool = False,
+                       out_path: str | None = None):
+    """The paper's headline figure: train (or validation) error against
+    elapsed wall-clock seconds, mean across ranks, one curve per labelled
+    run directory (≙ plotting.py:255-292 ``plot_itrs`` with
+    ``x='time'``)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(6, 4))
+    col = "val_mean" if val else "train_mean"
+    for label, directory in runs.items():
+        pdf = parse_epochs(directory, world_size, tag)
+        if col not in pdf:
+            continue
+        ax.plot(pdf["time"], pdf[col], "o-", label=label)
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel(("Validation" if val else "Training") + " Error (%)")
+    ax.grid(which="both", alpha=0.4)
+    ax.legend()
+    fig.tight_layout()
+    if out_path:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    return fig
 
 
 def _gather_rank_files(directory: str, world_size: int,

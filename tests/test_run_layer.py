@@ -202,6 +202,101 @@ def test_visualization_parses_trainer_csv(tmp_path):
     assert (tmp_path / "fig.png").exists()
 
 
+def test_error_vs_time_figure(tmp_path):
+    """The paper's headline error-vs-wall-time figure (reference
+    plotting.py:255-292, x='time'): per-epoch cross-rank means with the
+    elapsed-seconds estimate, train and val variants."""
+    from stochastic_gradient_push_tpu.visualization import (
+        parse_epochs, plot_error_vs_time)
+
+    header = (
+        "BEGIN-TRAINING\nWorld-Size,2\nNum-DLWorkers,0\nBatch-Size,8\n"
+        "Epoch,itr,BT(s),avg:BT(s),std:BT(s),NT(s),avg:NT(s),std:NT(s),"
+        "DT(s),avg:DT(s),std:DT(s),Loss,avg:Loss,Prec@1,avg:Prec@1,"
+        "Prec@5,avg:Prec@5,val\n")
+    for rank, (p1_a, p1_b, v_a, v_b) in enumerate(
+            [(10.0, 30.0, 25.0, 45.0), (20.0, 40.0, 25.0, 45.0)]):
+        (tmp_path / f"out_r{rank}_n2.csv").write_text(
+            header
+            + f"0,9,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+              f"2.0,2.0,{p1_a},{p1_a},50.0,50.0,-1\n"
+            + f"0,-1,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+              f"-1,-1,-1,-1,-1,-1,{v_a}\n"
+            + f"1,9,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+              f"1.5,1.5,{p1_b},{p1_b},60.0,60.0,-1\n"
+            + f"1,-1,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+              f"-1,-1,-1,-1,-1,-1,{v_b}\n")
+
+    pdf = parse_epochs(str(tmp_path), world_size=2)
+    assert len(pdf) == 2
+    # cross-rank mean train error: 100 - mean(10, 20) = 85, then 65
+    assert pdf["train_mean"].tolist() == [85.0, 65.0]
+    assert pdf["val_mean"].tolist() == [75.0, 55.0]
+    # elapsed: epoch-end itr × final mean avg:BT = (10, 20) × 0.2
+    assert pdf["time"].tolist() == [2.0, 4.0]
+
+    # a rank killed mid-epoch has an epoch-end train row without a val
+    # row: alignment is by Epoch, and means skip the missing entries
+    (tmp_path / "out_r1_n2.csv").write_text(
+        header
+        + "0,9,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+          "2.0,2.0,20.0,20.0,50.0,50.0,-1\n"
+        + "0,-1,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+          "-1,-1,-1,-1,-1,-1,45.0\n"
+        + "1,5,0.1,0.2,0.0,0.1,0.1,0.0,0.0,0.0,0.0,"
+          "1.5,1.5,40.0,40.0,60.0,60.0,-1\n")
+    pdf = parse_epochs(str(tmp_path), world_size=2)
+    assert pdf["train_mean"].tolist() == [85.0, 65.0]
+    # epoch 0: mean(75, 55); epoch 1: only rank 0's val row exists
+    assert pdf["val_mean"].tolist() == [65.0, 55.0]
+
+    plot_error_vs_time({"SGP": str(tmp_path)}, 2,
+                       out_path=str(tmp_path / "evt.png"))
+    plot_error_vs_time({"SGP": str(tmp_path)}, 2, val=True,
+                       out_path=str(tmp_path / "evt_val.png"))
+    assert (tmp_path / "evt.png").exists()
+    assert (tmp_path / "evt_val.png").exists()
+
+
+@pytest.mark.slow
+def test_cli_lm_resume_migrates_stale_csv_header(tmp_path):
+    """Resuming a run whose CSV predates a schema change re-seats every
+    old value under its original column name (a stale header must not
+    leave val_loss parsing as grad_norm)."""
+    base = [sys.executable, "-m",
+            "stochastic_gradient_push_tpu.run.gossip_lm",
+            "--world_size", "2", "--seq_len", "32", "--d_model", "32",
+            "--n_layers", "1", "--n_heads", "4", "--d_ff", "32",
+            "--vocab_size", "32", "--batch_size", "2",
+            "--corpus_tokens", "20000", "--checkpoint_dir", str(tmp_path)]
+    r = subprocess.run(base + ["--num_steps", "4"], capture_output=True,
+                       text=True, timeout=420, env=CLI_ENV)
+    assert r.returncode == 0, r.stderr[-2000:]
+    csv = tmp_path / "lm_out_n2.csv"
+    lines = csv.read_text().splitlines()
+    assert lines[0] == "step,loss,ppl,lr,tokens_per_sec,grad_norm"
+    # forge a pre-grad_norm file: drop the grad_norm column entirely
+    old_rows = [",".join(l.split(",")[:5]) for l in lines[1:]]
+    csv.write_text("step,loss,ppl,lr,tokens_per_sec\n"
+                   + "\n".join(old_rows) + "\n")
+    r = subprocess.run(base + ["--num_steps", "8", "--resume", "True"],
+                       capture_output=True, text=True, timeout=420,
+                       env=CLI_ENV)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = csv.read_text().splitlines()
+    assert lines[0] == "step,loss,ppl,lr,tokens_per_sec,grad_norm"
+    # old rows were padded with an empty grad_norm slot, new rows carry
+    # real values — and every loss still sits in the loss column
+    for line in lines[1:]:
+        cells = line.split(",")
+        assert len(cells) == 6
+        assert float(cells[1]) > 0  # loss
+    assert any(cells == "" for cells in
+               (l.split(",")[5] for l in lines[1:]))
+    assert any(c not in ("",) and float(c) > 0 for c in
+               (l.split(",")[5] for l in lines[1:]))
+
+
 def test_plot_scaling_and_transformer_parse(tmp_path):
     from stochastic_gradient_push_tpu.visualization import (
         parse_transformer_out,
