@@ -56,17 +56,36 @@ def initialize_multihost(coordinator_address: str | None = None,
     With no arguments, relies on the platform's auto-detection (Cloud TPU
     metadata).  Under SLURM, reads the same env vars the reference does
     (SLURM_PROCID / SLURM_NTASKS, gossip_sgd.py:604-605) and derives the
-    coordinator from the first node in the job's node list.
+    coordinator from the first node in the job's node list.  Under an
+    OpenMPI launcher (``mpirun``/``mpiexec``), reads the OMPI rank/size
+    vars the reference's ``--backend mpi`` path uses
+    (OMPI_COMM_WORLD_RANK / OMPI_UNIVERSE_SIZE, gossip_sgd.py:600-602);
+    the coordinator host comes from ``COORDINATOR_ADDRESS`` when set
+    (``host:port`` or bare host), falling back to the reference's
+    ``HOSTNAME`` convention (gossip_sgd.py:599 — correct when rank 0's
+    hostname is propagated by ``mpirun -x HOSTNAME``, the single-node
+    case, or any shared-hostname virtual cluster).
     """
-    if (coordinator_address is None and process_id is None
-            and "SLURM_PROCID" in os.environ):
-        process_id = int(os.environ["SLURM_PROCID"])
-        num_processes = int(os.environ["SLURM_NTASKS"])
-        nodelist = os.environ.get("SLURM_JOB_NODELIST", "")
-        head = (_first_slurm_host(nodelist) if nodelist
-                else os.environ.get("HOSTNAME", "localhost"))
-        port = os.environ.get("COORDINATOR_PORT", "40100")
-        coordinator_address = f"{head}:{port}"
+    if coordinator_address is None and process_id is None:
+        if "SLURM_PROCID" in os.environ:
+            process_id = int(os.environ["SLURM_PROCID"])
+            num_processes = int(os.environ["SLURM_NTASKS"])
+            nodelist = os.environ.get("SLURM_JOB_NODELIST", "")
+            head = (_first_slurm_host(nodelist) if nodelist
+                    else os.environ.get("HOSTNAME", "localhost"))
+            port = os.environ.get("COORDINATOR_PORT", "40100")
+            coordinator_address = f"{head}:{port}"
+        elif "OMPI_COMM_WORLD_RANK" in os.environ:
+            process_id = int(os.environ["OMPI_COMM_WORLD_RANK"])
+            num_processes = int(
+                os.environ.get("OMPI_COMM_WORLD_SIZE")
+                or os.environ["OMPI_UNIVERSE_SIZE"])
+            head = os.environ.get(
+                "COORDINATOR_ADDRESS",
+                os.environ.get("HOSTNAME", "localhost"))
+            if ":" not in head:
+                head = f"{head}:{os.environ.get('COORDINATOR_PORT', '40100')}"
+            coordinator_address = head
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

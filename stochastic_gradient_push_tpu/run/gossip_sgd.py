@@ -382,7 +382,12 @@ def _multihost_env() -> bool:
     if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
         return True
     try:
-        return int(os.environ.get("SLURM_NTASKS", "1")) > 1
+        if int(os.environ.get("SLURM_NTASKS", "1")) > 1:
+            return True
+        # OpenMPI launcher (reference --backend mpi, gossip_sgd.py:600-602)
+        return int(os.environ.get(
+            "OMPI_COMM_WORLD_SIZE",
+            os.environ.get("OMPI_UNIVERSE_SIZE", "1"))) > 1
     except ValueError:
         return False
 

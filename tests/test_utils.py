@@ -83,3 +83,73 @@ def test_slurm_nodelist_first_host():
     assert _first_slurm_host("a-1,b-2") == "a-1"
     assert _first_slurm_host("node[001-004]") == "node001"
     assert _first_slurm_host("single") == "single"
+
+
+def _captured_initialize(monkeypatch):
+    """Stub jax.distributed.initialize and return the capture dict."""
+    got = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        got.update(coordinator_address=coordinator_address,
+                   num_processes=num_processes, process_id=process_id)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    return got
+
+
+def test_mpi_env_bootstrap(monkeypatch):
+    """OpenMPI launcher env (reference --backend mpi, gossip_sgd.py:600-602)
+    derives rank/size; COORDINATOR_ADDRESS wins over HOSTNAME."""
+    from stochastic_gradient_push_tpu.parallel.discovery import (
+        initialize_multihost)
+
+    for var in ("SLURM_PROCID", "SLURM_NTASKS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "head-node:40123")
+    got = _captured_initialize(monkeypatch)
+    initialize_multihost()
+    assert got == {"coordinator_address": "head-node:40123",
+                   "num_processes": 4, "process_id": 3}
+
+    # reference fallbacks: OMPI_UNIVERSE_SIZE for world, HOSTNAME for the
+    # coordinator, default port appended to a bare host
+    monkeypatch.delenv("OMPI_COMM_WORLD_SIZE")
+    monkeypatch.delenv("COORDINATOR_ADDRESS")
+    monkeypatch.setenv("OMPI_UNIVERSE_SIZE", "8")
+    monkeypatch.setenv("HOSTNAME", "mpi-head")
+    got = _captured_initialize(monkeypatch)
+    initialize_multihost()
+    assert got == {"coordinator_address": "mpi-head:40100",
+                   "num_processes": 8, "process_id": 3}
+
+
+def test_slurm_env_wins_over_mpi(monkeypatch):
+    """When both schedulers' vars are present, SLURM keeps priority (the
+    reference selects by --backend; auto-detection must be deterministic)."""
+    from stochastic_gradient_push_tpu.parallel.discovery import (
+        initialize_multihost)
+
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    monkeypatch.setenv("SLURM_NTASKS", "2")
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "single")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "7")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "9")
+    got = _captured_initialize(monkeypatch)
+    initialize_multihost()
+    assert got["process_id"] == 1
+    assert got["num_processes"] == 2
+
+
+def test_mpi_env_multihost_autodetect(monkeypatch):
+    from stochastic_gradient_push_tpu.run.gossip_sgd import _multihost_env
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE",
+                "OMPI_UNIVERSE_SIZE"):
+        monkeypatch.delenv(var, raising=False)
+    assert not _multihost_env()
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    assert _multihost_env()
