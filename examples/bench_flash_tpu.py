@@ -93,6 +93,34 @@ def run(b, h, t, d, causal=True, dtype=jnp.bfloat16):
     return r
 
 
+def sweep_blocks(b, h, t, d, causal=True, dtype=jnp.bfloat16):
+    """Block-size sweep for the fused kernels at one shape: the 3-D-grid
+    schedule keeps VMEM at O(block²), so blocks up to 512 are in play;
+    record which (block_q, block_k) wins so the defaults can follow."""
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, t, d)) * 0.5, dtype)
+               for _ in range(3))
+    for blk in (128, 256, 512):
+        if t % blk:
+            continue
+
+        def loss(q, k, v, blk=blk):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           block_q=blk, block_k=blk)
+                           .astype(jnp.float32) ** 2)
+
+        fwd = jax.jit(lambda q, k, v, blk=blk: flash_attention(
+            q, k, v, causal=causal, block_q=blk, block_k=blk))
+        bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            r = {"sweep": f"t{t} block{blk}",
+                 "fwd_ms": round(timed(fwd, q, k, v), 3),
+                 "bwd_ms": round(timed(bwd, q, k, v), 3)}
+        except Exception as e:  # Mosaic rejection at this block size
+            r = {"sweep": f"t{t} block{blk}", "error": repr(e)[:200]}
+        print(json.dumps(r), flush=True)
+
+
 if __name__ == "__main__":
     print(f"backend: {jax.default_backend()} "
           f"({jax.devices()[0].device_kind})", flush=True)
@@ -100,3 +128,5 @@ if __name__ == "__main__":
     for t in (1024, 2048, 4096):
         run(4, 8, t, 64, causal=True)
     run(4, 8, 2048, 64, causal=False)
+    sweep_blocks(4, 8, 4096, 64, causal=True)
+    sweep_blocks(4, 8, 2048, 64, causal=True)

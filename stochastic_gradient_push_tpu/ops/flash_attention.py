@@ -1,35 +1,38 @@
 """Pallas TPU flash-attention kernels: fused forward AND backward.
 
-The transformer path's compute hot spot.  Forward: one grid cell per
-(batch·head, q-block): the q block stays resident in VMEM while k/v blocks
-stream through, accumulating with the online-softmax recurrence — O(block²)
-VMEM instead of O(seq²) HBM, and causal upper-triangle blocks are skipped
-entirely (≈2× fewer FLOPs at long sequence).  The forward also emits the
-per-row logsumexp so the backward can recompute attention probabilities
-without a second softmax reduction.
+The transformer path's compute hot spot.  All three kernels share one
+schedule shape: a 3-D grid whose two major dimensions are parallel
+(batch·head and the output block) and whose MINOR dimension walks the
+streamed axis with ``arbitrary`` semantics — so Pallas double-buffers the
+streamed k/v (or q/do) block fetches behind the matmuls instead of
+parking whole ``[seq, d]`` operands in VMEM per cell (the round-3 design,
+whose dk/dv kernel lost to XLA 122.8 ms vs 68.6 ms at t=4096 —
+docs/FLASH_TPU_RESULTS.txt).  Running state lives in fp32 VMEM scratch
+that persists across the minor grid steps: the forward carries the
+online-softmax ``(m, den, acc)`` triple, the backward kernels carry their
+gradient accumulators, and outputs are written once on the last minor
+step.  VMEM per cell is O(block²), independent of sequence length.
 
-Backward (``jax.custom_vjp``): two fused kernels in the standard
-flash-attention-2 decomposition —
+Backward (``jax.custom_vjp``) is the standard flash-attention-2
+decomposition:
 
-* dQ kernel, grid over (batch·head, q-block): streams k/v blocks,
-  recomputes ``p = exp(s - lse)``, accumulates ``dq += ds @ k``.
-* dK/dV kernel, grid over (batch·head, k-block): streams q/do blocks,
-  accumulates ``dv += pᵀ @ do`` and ``dk += dsᵀ @ q``.
+* dQ kernel, grid ``(bh, q-block, k-step)``: streams k/v, recomputes
+  ``p = exp(s - lse)``, accumulates ``dq += ds @ k``.
+* dK/dV kernel, grid ``(bh, k-block, q-step)``: streams q/do, accumulates
+  ``dv += pᵀ @ do`` and ``dk += dsᵀ @ q``.
 
-Both use ``delta = rowsum(do · o)`` in place of materializing dP; it is
-computed *inside* the kernels from the streamed ``o``/``do`` blocks (an
-elementwise multiply-reduce, negligible next to the matmuls), so no delta
-array ever exists in HBM.  The logsumexp residual travels in a compact
-``[rows, 1]`` layout — a round-2 revision materialized lse and delta as
-lane-broadcast ``[rows, 128]`` fp32 HBM operands (128× their logical
-size; 2 MB of VMEM each per grid cell at t=4096, the likely cause of the
-recorded dk/dv slowdown at long sequence — docs/FLASH_TPU_RESULTS.txt).
-Causal runs skip the empty triangle blocks in both kernels.
+The per-row residuals travel in compact ``[rows, 1]`` layouts: the
+forward's logsumexp and ``delta = rowsum(do · o)``, the latter computed
+once outside the kernels (a fused XLA elementwise-reduce) so ``o`` is not
+an operand of either backward kernel.  Causal runs skip the empty
+triangle two ways: masked minor steps are compute-gated with ``pl.when``,
+and their index maps clamp into the visible range so no new block is ever
+fetched for a skipped step.
 
 On non-TPU backends ``flash_attention`` transparently falls back to the
 pure-JAX blockwise implementation
 (parallel/ring_attention.py::blockwise_attention); Pallas interpret mode
-exercises both kernels in tests against that same oracle.
+exercises all three kernels in tests against that same oracle.
 """
 
 from __future__ import annotations
@@ -39,6 +42,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..parallel.ring_attention import blockwise_attention
 
@@ -48,63 +52,83 @@ __all__ = ["flash_attention", "flash_attention_forward",
 NEG_INF = -1e30
 
 # Mosaic requires the last two block dims be (8·k, 128·k) or full-size.
-# Per-row scalars (the logsumexp) ride as a [rows, 1] column — the last
-# dim is the ARRAY's full size (1), which Mosaic accepts, so the residual
+# Per-row scalars (logsumexp, delta) ride as a [rows, 1] column — the last
+# dim is the ARRAY's full size (1), which Mosaic accepts, so each residual
 # costs t floats instead of the 128·t a lane-broadcast layout would.
 SCALAR_COLS = 1
 
+# fp32 running-state scratch keeps a full [rows, 128] lane so stores hit
+# the native register layout; only column 0 is meaningful
+_STATE_LANES = 128
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, block_q: int,
-                  block_k: int, seq_len: int, causal: bool):
-    """One (batch·head, q-block) cell.  Refs: q [block_q, d];
-    k/v [seq, d]; o [block_q, d]; lse (when requested)
-    [block_q, SCALAR_COLS]."""
-    qi = pl.program_id(1)
-    d = q_ref.shape[-1]
-    q = q_ref[:].astype(jnp.float32) * (d ** -0.5)
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    den = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+def _compiler_params(interpret: bool):
+    """Minor grid dim walks the streamed axis: revisited outputs/scratch
+    require ``arbitrary``; the two major dims are parallel."""
+    if interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
-    num_k_blocks = seq_len // block_k
 
-    def body(kj, carry):
-        m, den, acc = carry
-        k_blk = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # [bq, bk]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        den = den * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, den, acc
+def _causal_mask(s, qi, kj, block_q: int, block_k: int):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
-    if causal:
-        # skip blocks strictly above the diagonal
-        last_block = qi * block_q // block_k + \
-            (block_q + block_k - 1) // block_k
-        upper = jnp.minimum(num_k_blocks, last_block)
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
+                      block_k: int, causal: bool, return_lse: bool):
+    """One (batch·head, q-block, k-step) cell.  Refs: q/o [block_q, d];
+    k/v [block_k, d] (streamed); lse (when requested)
+    [block_q, SCALAR_COLS]; scratch m/den [block_q, 128] and
+    acc [block_q, d], all fp32, persistent across k-steps."""
+    if return_lse:
+        lse_ref, m_ref, den_ref, acc_ref = rest
     else:
-        upper = num_k_blocks
-    m, den, acc = jax.lax.fori_loop(0, upper, body, (m, den, acc))
-    o_ref[:] = (acc / den[:, None]).astype(o_ref.dtype)
-    if maybe_lse:
-        # per-row logsumexp of the scaled scores — the backward's residual
-        lse_ref, = maybe_lse
-        lse_ref[:] = (m + jnp.log(den))[:, None]
+        m_ref, den_ref, acc_ref = rest
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        den_ref[:] = jnp.zeros_like(den_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    visible = (qi * block_q + block_q - 1 >= kj * block_k) if causal \
+        else (kj >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        d = q_ref.shape[-1]
+        q = q_ref[:].astype(jnp.float32) * (d ** -0.5)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        m_prev = m_ref[:, :1]                              # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                    # [bq, 1]
+        den_new = den_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        den_ref[:] = jnp.broadcast_to(den_new, den_ref.shape)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        den = den_ref[:, :1]
+        o_ref[:] = (acc_ref[:] / den).astype(o_ref.dtype)
+        if return_lse:
+            lse_ref[:] = m_ref[:, :1] + jnp.log(den)
 
 
 def flash_attention_forward(q, k, v, causal: bool = False,
@@ -127,28 +151,42 @@ def flash_attention_forward(q, k, v, causal: bool = False,
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
 
+    def kv_map(bh, qi, kj):
+        if causal:
+            # masked steps re-point at the last visible block: same index
+            # as the previous step ⇒ Pallas skips the fetch entirely
+            kj = jnp.minimum(kj, (qi * block_q + block_q - 1) // block_k)
+        return (bh, kj, 0)
+
     kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=t,
-        causal=causal)
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k,
+        causal=causal, return_lse=return_lse)
     out_specs = [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
     ]
     out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
     if return_lse:
         out_specs.append(pl.BlockSpec((None, block_q, SCALAR_COLS),
-                                      lambda bh, qi: (bh, qi, 0)))
+                                      lambda bh, qi, kj: (bh, qi, 0)))
         out_shape.append(jax.ShapeDtypeStruct((b * h, t, SCALAR_COLS),
                                               jnp.float32))
     results = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(b * h, t // block_q, t // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d),
+                         lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATE_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STATE_LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(qf, kf, vf)
     if return_lse:
@@ -158,111 +196,95 @@ def flash_attention_forward(q, k, v, causal: bool = False,
     return out.reshape(b, h, t, d)
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                     dq_ref, *, block_q: int, block_k: int, seq_len: int,
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, acc_ref, *, block_q: int, block_k: int,
                      causal: bool):
-    """dQ cell: one (batch·head, q-block); k/v stream through.
-    Refs: q/o/do/dq [block_q, d]; k/v [seq, d]; lse
-    [block_q, SCALAR_COLS].  ``delta = rowsum(do · o)`` is computed here
-    rather than shipped as an operand."""
-    qi = pl.program_id(1)
-    d = q_ref.shape[-1]
-    scale = d ** -0.5
-    q = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:][:, 0]
-    delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=-1)
+    """dQ cell (bh, q-block, k-step).  Refs: q/do/dq [block_q, d];
+    k/v [block_k, d] (streamed); lse/delta [block_q, SCALAR_COLS];
+    scratch acc [block_q, d] fp32."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    num_k_blocks = seq_len // block_k
-    dq = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def body(kj, dq):
-        k_blk = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    visible = (qi * block_q + block_q - 1 >= kj * block_k) if causal \
+        else (kj >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        d = q_ref.shape[-1]
+        scale = d ** -0.5
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [bq, bk]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                  # [bq, bk]
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse_ref[:])                        # [bq, bk]
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [bq, bk]
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-    if causal:
-        last_block = qi * block_q // block_k + \
-            (block_q + block_k - 1) // block_k
-        upper = jnp.minimum(num_k_blocks, last_block)
-    else:
-        upper = num_k_blocks
-    dq = jax.lax.fori_loop(0, upper, body, dq)
-    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta_ref[:])
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                      dk_ref, dv_ref, *, block_q: int, block_k: int,
-                      seq_len: int, causal: bool):
-    """dK/dV cell: one (batch·head, k-block); q/o/do stream through.
-    Refs: k/v/dk/dv [block_k, d]; q/o/do [seq, d]; lse
-    [seq, SCALAR_COLS].  delta is recomputed per streamed q-block from
-    ``do · o`` — an elementwise reduce per (k-block, q-block) pair,
-    negligible next to the four matmuls in the same body."""
-    kj = pl.program_id(1)
-    d = k_ref.shape[-1]
-    scale = d ** -0.5
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                      block_k: int, causal: bool):
+    """dK/dV cell (bh, k-block, q-step).  Refs: k/v/dk/dv [block_k, d];
+    q/do [block_q, d] (streamed); lse/delta [block_q, SCALAR_COLS];
+    scratch dk/dv accumulators [block_k, d] fp32."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
 
-    num_q_blocks = seq_len // block_q
-    dk = jnp.zeros((block_k, d), jnp.float32)
-    dv = jnp.zeros((block_k, d), jnp.float32)
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def body(qi, carry):
-        dk, dv = carry
-        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[pl.ds(qi * block_q, block_q), :][:, 0]
-        delta_blk = jnp.sum(
-            do_blk * o_ref[pl.ds(qi * block_q, block_q), :].astype(
-                jnp.float32), axis=-1)
+    visible = (qi * block_q + block_q - 1 >= kj * block_k) if causal \
+        else (qi >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        d = k_ref.shape[-1]
+        scale = d ** -0.5
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        q = q_ref[:].astype(jnp.float32) * scale
+        do = do_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [bq, bk]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])              # [bq, bk]
-        dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [bk, d]
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse_ref[:])                        # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
         dp = jax.lax.dot_general(
-            do_blk, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [bq, bk]
-        ds = p * (dp - delta_blk[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [bk, d]
-        return dk, dv
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta_ref[:])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
 
-    if causal:
-        # the first q block whose rows can see this k block
-        lower = (kj * block_k) // block_q
-    else:
-        lower = 0
-    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (dk, dv))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
@@ -270,10 +292,11 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
                              interpret: bool = False):
     """Fused Pallas backward: returns ``(dq, dk, dv)``.
 
-    ``lse`` is the forward's row logsumexp ``[b, h, seq]``, shipped in the
-    compact ``[rows, 1]`` layout; ``delta = rowsum(do · out)`` is computed
-    inside the kernels from the streamed ``out``/``do`` blocks, so neither
-    scalar family ever exists as a lane-broadcast HBM array.
+    ``lse`` is the forward's row logsumexp ``[b, h, seq]``; it and
+    ``delta = rowsum(do · out)`` (computed here, once, as a fused XLA
+    reduce) ship in the compact ``[rows, 1]`` layout, so no
+    lane-broadcast scalar array ever exists in HBM and ``out`` is not an
+    operand of either kernel.
     """
     b, h, t, d = q.shape
     block_q = min(block_q, t)
@@ -285,54 +308,72 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
-    of = out.reshape(b * h, t, d)
     dof = do.reshape(b * h, t, d)
     lsef = lse.reshape(b * h, t)[..., None]  # [b*h, t, SCALAR_COLS]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, t)[..., None]
 
-    row_specs = [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
-        pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # k
-        pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),         # v
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # o
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),  # do
-        pl.BlockSpec((None, block_q, SCALAR_COLS),
-                     lambda bh, qi: (bh, qi, 0)),                      # lse
-    ]
+    def kv_map(bh, qi, kj):
+        if causal:
+            kj = jnp.minimum(kj, (qi * block_q + block_q - 1) // block_k)
+        return (bh, kj, 0)
+
+    q_row = pl.BlockSpec((None, block_q, d),
+                         lambda bh, qi, kj: (bh, qi, 0))
+    s_row = pl.BlockSpec((None, block_q, SCALAR_COLS),
+                         lambda bh, qi, kj: (bh, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q,
-                          block_k=block_k, seq_len=t, causal=causal),
-        grid=(b * h, t // block_q),
-        in_specs=row_specs,
-        out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda bh, qi: (bh, qi, 0)),
+                          block_k=block_k, causal=causal),
+        grid=(b * h, t // block_q, t // block_k),
+        in_specs=[
+            q_row,                                          # q
+            pl.BlockSpec((None, block_k, d), kv_map),       # k
+            pl.BlockSpec((None, block_k, d), kv_map),       # v
+            q_row,                                          # do
+            s_row,                                          # lse
+            s_row,                                          # delta
+        ],
+        out_specs=q_row,
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(qf, kf, vf, of, dof, lsef)
+    )(qf, kf, vf, dof, lsef, delta)
 
-    col_specs = [
-        pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # q
-        pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # k
-        pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),  # v
-        pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # o
-        pl.BlockSpec((None, t, d), lambda bh, kj: (bh, 0, 0)),         # do
-        pl.BlockSpec((None, t, SCALAR_COLS),
-                     lambda bh, kj: (bh, 0, 0)),                       # lse
-    ]
+    def q_map(bh, kj, qi):
+        if causal:
+            # the first visible q-step for this k-block; earlier (masked)
+            # steps alias it so no block is fetched for them
+            qi = jnp.maximum(qi, (kj * block_k) // block_q)
+        return (bh, qi, 0)
+
+    k_col = pl.BlockSpec((None, block_k, d),
+                         lambda bh, kj, qi: (bh, kj, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q,
-                          block_k=block_k, seq_len=t, causal=causal),
-        grid=(b * h, t // block_k),
-        in_specs=col_specs,
-        out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, kj: (bh, kj, 0)),
+                          block_k=block_k, causal=causal),
+        grid=(b * h, t // block_k, t // block_q),
+        in_specs=[
+            k_col,                                          # k
+            k_col,                                          # v
+            pl.BlockSpec((None, block_q, d), q_map),        # q
+            pl.BlockSpec((None, block_q, d), q_map),        # do
+            pl.BlockSpec((None, block_q, SCALAR_COLS), q_map),   # lse
+            pl.BlockSpec((None, block_q, SCALAR_COLS), q_map),   # delta
         ],
+        out_specs=[k_col, k_col],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(qf, kf, vf, of, dof, lsef)
+    )(kf, vf, qf, dof, lsef, delta)
     return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
             dv.reshape(b, h, t, d))
 

@@ -35,10 +35,13 @@ def test_flash_kernel_matches_blockwise(qkv, causal, block):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_kernel_mixed_block_sizes(qkv):
+@pytest.mark.parametrize("block_q,block_k", [(16, 32), (32, 16)])
+def test_flash_kernel_mixed_block_sizes(qkv, block_q, block_k):
+    """Both aspect ratios exercise the causal index-map clamps (a
+    wrong floor in either direction reads the wrong streamed block)."""
     q, k, v = qkv
-    got = flash_attention_forward(q, k, v, causal=True, block_q=16,
-                                  block_k=32, interpret=True)
+    got = flash_attention_forward(q, k, v, causal=True, block_q=block_q,
+                                  block_k=block_k, interpret=True)
     want = blockwise_attention(q, k, v, 16, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -94,19 +97,20 @@ def test_flash_backward_kernels_match_oracle(qkv, causal, block):
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_flash_backward_mixed_block_sizes(qkv):
+@pytest.mark.parametrize("block_q,block_k", [(16, 32), (32, 16)])
+def test_flash_backward_mixed_block_sizes(qkv, block_q, block_k):
     from stochastic_gradient_push_tpu.ops.flash_attention import (
         flash_attention_backward)
 
     q, k, v = qkv
-    out, lse = flash_attention_forward(q, k, v, causal=True, block_q=16,
-                                       block_k=32, interpret=True,
-                                       return_lse=True)
+    out, lse = flash_attention_forward(q, k, v, causal=True,
+                                       block_q=block_q, block_k=block_k,
+                                       interpret=True, return_lse=True)
     rng = np.random.default_rng(4)
     do = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
     dq, dk, dv = flash_attention_backward(
-        q, k, v, out, lse, do, causal=True, block_q=16, block_k=32,
-        interpret=True)
+        q, k, v, out, lse, do, causal=True, block_q=block_q,
+        block_k=block_k, interpret=True)
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(q, k, v, 16, causal=True),
         q, k, v)
