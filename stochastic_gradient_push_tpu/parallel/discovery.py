@@ -80,9 +80,22 @@ def initialize_multihost(coordinator_address: str | None = None,
             num_processes = int(
                 os.environ.get("OMPI_COMM_WORLD_SIZE")
                 or os.environ["OMPI_UNIVERSE_SIZE"])
-            head = os.environ.get(
-                "COORDINATOR_ADDRESS",
-                os.environ.get("HOSTNAME", "localhost"))
+            head = os.environ.get("COORDINATOR_ADDRESS")
+            if head is None:
+                # HOSTNAME fallback only works when every rank resolves
+                # the SAME host (mpirun -x HOSTNAME, or single-node);
+                # otherwise rank>0 would dial itself and hang in
+                # jax.distributed.initialize with no diagnostic — a
+                # multi-node local-hostname guess must fail fast instead
+                local = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE",
+                                           num_processes))
+                if num_processes > local and process_id > 0:
+                    raise RuntimeError(
+                        "multi-node MPI launch needs COORDINATOR_ADDRESS "
+                        "(host[:port] of rank 0) or mpirun -x HOSTNAME; "
+                        "refusing to guess a coordinator from this "
+                        "rank's own hostname")
+                head = os.environ.get("HOSTNAME", "localhost")
             if ":" not in head:
                 head = f"{head}:{os.environ.get('COORDINATOR_PORT', '40100')}"
             coordinator_address = head

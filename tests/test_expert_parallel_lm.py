@@ -92,6 +92,7 @@ def test_moe_lm_trains_with_gossip_and_ep():
     assert np.all(np.isfinite(r)) and np.abs(r).max() > 0
 
 
+@pytest.mark.slow
 def test_ep_train_step_matches_full_expert_model():
     """One momentum-free SGD step on the (gossip=1, ep=2) mesh moves every
     param — expert slices included — by exactly ``-lr * grad`` of the
@@ -174,6 +175,7 @@ def test_composition_fences_raise_clean_errors():
         main(base + ["--ep", "2", "--attn", "ring"])
 
 
+@pytest.mark.slow
 def test_moe_ep_sp_tp_4d_trains(tmp_path):
     """All four axes at once: gossip × ep × seq × tp on one 4-D mesh,
     with held-out validation through the same composed forward."""
@@ -193,6 +195,7 @@ def test_moe_ep_sp_tp_4d_trains(tmp_path):
     assert np.isfinite(r["val_loss"])
 
 
+@pytest.mark.slow
 def test_moe_with_ring_sp_trains(tmp_path):
     """MoE composed with ring sequence parallelism (per-block routing)
     trains end-to-end through the CLI."""
@@ -209,6 +212,7 @@ def test_moe_with_ring_sp_trains(tmp_path):
     assert np.isfinite(r["final_loss"])
 
 
+@pytest.mark.slow
 def test_moe_ep_with_tp_matches_ep_only(tmp_path):
     """ep × tp: expert parallelism (manual all_to_all dispatch over ep)
     composed with GSPMD tensor parallelism on the 3-D (gossip, ep, tp)
@@ -254,6 +258,7 @@ def test_moe_ep_with_tp_matches_ep_only(tmp_path):
         P("gossip", None, None)
 
 
+@pytest.mark.slow
 def test_moe_pp_trains(tmp_path):
     """MoE × pipeline through the CLI: replicated expert blocks routed per
     microbatch inside the tick schedule (moe_every=1)."""
@@ -274,6 +279,7 @@ def test_moe_pp_trains(tmp_path):
     assert np.isfinite(r["val_loss"])
 
 
+@pytest.mark.slow
 def test_moe_pp_ep_trains(tmp_path):
     """pp × ep through the CLI: expert-sharded dispatch (all_to_all over
     ep) inside the pipeline tick schedule, with held-out validation."""
@@ -293,6 +299,7 @@ def test_moe_pp_ep_trains(tmp_path):
     assert np.isfinite(r["val_loss"])
 
 
+@pytest.mark.slow
 def test_moe_pp_sp_trains(tmp_path):
     """MoE × pp × sp through the CLI: per-block expert routing inside the
     ring-attention pipeline ticks."""
@@ -310,6 +317,7 @@ def test_moe_pp_sp_trains(tmp_path):
     assert np.isfinite(r["final_loss"])
 
 
+@pytest.mark.slow
 def test_moe_pp_ep_sp_4d_trains(tmp_path):
     """The 4-D pipeline mesh through the CLI: gossip × pipe × ep × seq
     with validation through the same composed forward."""

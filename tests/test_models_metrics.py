@@ -30,6 +30,7 @@ def test_resnet50_structure_and_init():
     assert "batch_stats" in variables
 
 
+@pytest.mark.slow
 def test_resnet_zero_gamma_and_fc_init():
     model = resnet50(num_classes=10)
     variables = model.init(jax.random.PRNGKey(0),

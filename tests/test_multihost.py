@@ -20,6 +20,10 @@ import sys
 import numpy as np
 import pytest
 
+# every test here launches 2 OS processes that rendezvous over
+# jax.distributed and compile their own programs — minutes each
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

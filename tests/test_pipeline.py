@@ -85,6 +85,7 @@ def _reference_loss_and_grads(cfg, ref_params, toks, tgts):
 
 
 class TestPipelineParity:
+    @pytest.mark.slow
     def test_forward_loss_matches_stacked_model(self):
         n_layers, pp, n_micro = 4, 4, 4
         model, cfg, state, train_fn, toks, tgts = _setup(
@@ -98,6 +99,7 @@ class TestPipelineParity:
         np.testing.assert_allclose(loss, float(ref_loss), rtol=2e-5,
                                    atol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_match_stacked_model(self):
         """One momentum-free SGD step: params move by exactly -lr * grad of
         the stacked model, for stage-local AND pipe-replicated leaves."""
@@ -120,6 +122,7 @@ class TestPipelineParity:
                 np.asarray(n), np.asarray(e), rtol=5e-4, atol=1e-5,
                 err_msg=jax.tree_util.keystr(path_e))
 
+    @pytest.mark.slow
     def test_more_microbatches_than_stages(self):
         n_layers, pp, n_micro = 2, 2, 3
         model, cfg, state, train_fn, toks, tgts = _setup(
@@ -164,6 +167,7 @@ class TestPipelineParity:
         np.testing.assert_allclose(got.reshape(ref_logits.shape),
                                    ref_logits, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_moe_pp_matches_stacked_model(self):
         """MoE × pipeline (every layer an expert block, routed per
         microbatch inside the ticks): with no-drop capacity, routing is
@@ -192,6 +196,7 @@ class TestPipelineParity:
                 np.asarray(n), np.asarray(e), rtol=5e-4, atol=1e-5,
                 err_msg=jax.tree_util.keystr(path_e))
 
+    @pytest.mark.slow
     def test_remat_matches(self):
         n_layers, pp, n_micro = 2, 2, 2
         _, _, state, train_fn, toks, tgts = _setup(1, pp, n_layers, n_micro)
@@ -205,6 +210,7 @@ class TestPipelineParity:
 
 
 class TestPipelineExpert:
+    @pytest.mark.slow
     def test_pp_ep_eval_matches_assembled_model(self):
         """pp × ep: the MoE all_to_all dispatches token slots over ep
         inside each tick.  Under no-drop capacity routing is per-token,
@@ -252,6 +258,7 @@ class TestPipelineExpert:
                                        rtol=2e-5, atol=2e-5)
 
 
+    @pytest.mark.slow
     def test_pp_ep_train_matches_assembled_model(self):
         """pp × ep one momentum-free SGD step: every param — expert
         slices included — moves by exactly ``-lr * grad`` of the stacked
@@ -312,6 +319,7 @@ class TestPipelineExpert:
                 np.asarray(n), np.asarray(e), rtol=5e-4, atol=1e-5,
                 err_msg=jax.tree_util.keystr(path_e))
 
+    @pytest.mark.slow
     def test_pp_sp_moe_eval_matches_assembled_model(self):
         """MoE × pp × sp: per-block expert routing (no collectives when
         ep is off) inside the ring-attention pipeline ticks.  Under
@@ -472,6 +480,7 @@ class TestPipelineGossip:
 
 
 class TestPipelineRing:
+    @pytest.mark.slow
     def test_pp_sp_matches_pp_only(self, tmp_path):
         """pp × sp through the CLI: ring attention inside the pipeline
         tick body (KV rotation over seq, activations over pipe) produces

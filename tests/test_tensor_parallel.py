@@ -74,6 +74,7 @@ def run_steps(train_fn, state, n=6):
     return state, losses
 
 
+@pytest.mark.slow
 def test_tp_matches_tp1_trajectory():
     cfg = TransformerConfig(vocab_size=VOCAB, d_model=D, n_layers=LAYERS,
                             n_heads=HEADS, d_ff=FF, max_len=SEQ,
@@ -134,6 +135,7 @@ def test_tp_kernels_are_actually_sharded():
     assert any("tp" in str(leaf.sharding.spec) for _, leaf in mom)
 
 
+@pytest.mark.slow
 def test_three_way_dp_sp_tp_trains():
     """Full composition: 2 gossip replicas x 2 sequence shards x 2 tensor
     shards on 8 devices — ring attention over the manual seq axis while
@@ -175,6 +177,7 @@ def test_three_way_dp_sp_tp_trains():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.95
 
 
+@pytest.mark.slow
 def test_moe_with_tp_matches_tp1():
     """MoE + tensor parallelism: expert FF dims shard over the auto tp
     axis; the trajectory must match tp=1 exactly."""

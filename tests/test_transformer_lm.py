@@ -180,6 +180,7 @@ def test_lm_cli_checkpoint_and_resume(tmp_path):
     assert 4 in steps and 8 in steps
 
 
+@pytest.mark.slow
 def test_lm_cli_orbax_backend_save_and_resume(tmp_path):
     """--ckpt_backend orbax through the LM CLI: per-step orbax saves with
     retention, then resume from the latest step."""
@@ -208,6 +209,7 @@ def test_lm_cli_orbax_backend_save_and_resume(tmp_path):
     assert 4 in steps and 8 in steps
 
 
+@pytest.mark.slow
 def test_scanned_lm_step_matches_sequential():
     """shard_scanned_lm_step(n) produces the same state and per-step losses
     as n individual dispatches, for plain dp and dp x sp (ring) layouts."""
@@ -264,6 +266,7 @@ def test_scanned_lm_step_matches_sequential():
                                        rtol=2e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_lm_cli_validation(tmp_path):
     """--val_frac holds out corpus tail; val_loss/val_ppl columns appear at
     --val_every steps and at the end, for both plain and ring layouts."""

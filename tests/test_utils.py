@@ -126,6 +126,31 @@ def test_mpi_env_bootstrap(monkeypatch):
                    "num_processes": 8, "process_id": 3}
 
 
+def test_mpi_multinode_without_coordinator_fails_fast(monkeypatch):
+    """A multi-node mpirun with no COORDINATOR_ADDRESS must raise, not
+    let every rank dial its own hostname and hang in initialize."""
+    import pytest
+
+    from stochastic_gradient_push_tpu.parallel.discovery import (
+        initialize_multihost)
+
+    for var in ("SLURM_PROCID", "SLURM_NTASKS", "COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
+    _captured_initialize(monkeypatch)
+    with pytest.raises(RuntimeError, match="COORDINATOR_ADDRESS"):
+        initialize_multihost()
+
+    # single-node (local size == world size): HOSTNAME fallback is fine
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "4")
+    monkeypatch.setenv("HOSTNAME", "onebox")
+    got = _captured_initialize(monkeypatch)
+    initialize_multihost()
+    assert got["coordinator_address"] == "onebox:40100"
+
+
 def test_slurm_env_wins_over_mpi(monkeypatch):
     """When both schedulers' vars are present, SLURM keeps priority (the
     reference selects by --backend; auto-detection must be deterministic)."""
