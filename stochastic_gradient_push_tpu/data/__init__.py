@@ -8,12 +8,14 @@ from .pipeline import (
     ShardedLoader,
     imagefolder_arrays,
     synthetic_classification,
+    translated_patch_classification,
 )
 
 __all__ = [
     "DistributedSampler",
     "ShardedLoader",
     "synthetic_classification",
+    "translated_patch_classification",
     "imagefolder_arrays",
     "synthetic_lm_corpus",
     "lm_batches",

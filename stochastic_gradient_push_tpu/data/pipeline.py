@@ -132,6 +132,36 @@ def synthetic_classification(n: int, num_classes: int = 10,
     return images.astype(dtype), labels.astype(np.int32)
 
 
+def translated_patch_classification(
+        n: int, num_classes: int = 16, image_size: int = 24,
+        patch_size: int = 8, channels: int = 3, seed: int = 0,
+        noise: float = 1.0, dtype=np.float32
+        ) -> tuple[np.ndarray, np.ndarray]:
+    """Harder synthetic task for non-toy convergence studies.
+
+    Each class is a fixed random ``patch_size``² pattern placed at a
+    RANDOM position on a noise background, so the label is not linearly
+    separable in pixel space — a model must learn translation-robust
+    (convolutional) features, unlike :func:`synthetic_classification`
+    whose class means a linear probe separates.  Used by
+    examples/convergence_resnet.py for the D3-style acceptance
+    methodology (BASELINE.md) on ResNet-18.
+    """
+    g = np.random.default_rng(seed)
+    patches = g.normal(scale=1.5,
+                       size=(num_classes, patch_size, patch_size, channels))
+    labels = g.integers(0, num_classes, size=(n,))
+    images = g.normal(scale=noise,
+                      size=(n, image_size, image_size, channels))
+    span = image_size - patch_size + 1
+    rows = g.integers(0, span, size=(n,))
+    cols = g.integers(0, span, size=(n,))
+    for i in range(n):
+        images[i, rows[i]:rows[i] + patch_size,
+               cols[i]:cols[i] + patch_size] += patches[labels[i]]
+    return images.astype(dtype), labels.astype(np.int32)
+
+
 def imagefolder_arrays(root: str, split: str, image_size: int = 224,
                        train: bool = True,
                        limit: int | None = None) -> tuple[np.ndarray, np.ndarray]:

@@ -83,19 +83,29 @@ def initialize_multihost(coordinator_address: str | None = None,
             head = os.environ.get("COORDINATOR_ADDRESS")
             if head is None:
                 # HOSTNAME fallback only works when every rank resolves
-                # the SAME host (mpirun -x HOSTNAME, or single-node);
-                # otherwise rank>0 would dial itself and hang in
-                # jax.distributed.initialize with no diagnostic — a
-                # multi-node local-hostname guess must fail fast instead
+                # the SAME host (mpirun -x HOSTNAME propagates rank 0's,
+                # or single-node).  A propagated hostname is detectable
+                # on a remote node: env HOSTNAME differs from the
+                # machine's own name.  A rank>0 whose env HOSTNAME is
+                # just its own machine would dial itself and hang in
+                # jax.distributed.initialize with no diagnostic — fail
+                # fast there instead.  (Rank 0 always listens on its own
+                # host, which is correct whenever the launch is sound;
+                # on a broken launch the raising peers exit nonzero and
+                # mpirun's default error handling tears the job down.)
+                import socket
                 local = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE",
                                            num_processes))
-                if num_processes > local and process_id > 0:
+                env_host = os.environ.get("HOSTNAME")
+                propagated = env_host not in (None, socket.gethostname())
+                if (num_processes > local and process_id > 0
+                        and not propagated):
                     raise RuntimeError(
                         "multi-node MPI launch needs COORDINATOR_ADDRESS "
                         "(host[:port] of rank 0) or mpirun -x HOSTNAME; "
                         "refusing to guess a coordinator from this "
                         "rank's own hostname")
-                head = os.environ.get("HOSTNAME", "localhost")
+                head = env_host or "localhost"
             if ":" not in head:
                 head = f"{head}:{os.environ.get('COORDINATOR_PORT', '40100')}"
             coordinator_address = head

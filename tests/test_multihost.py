@@ -79,7 +79,6 @@ def _run_pair(port: int, ckpt_dir: str, epochs: int, resume: str,
     return outs
 
 
-@pytest.mark.slow
 def test_two_process_train_and_resume(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
     port = _free_port()
@@ -112,7 +111,6 @@ def test_two_process_train_and_resume(tmp_path):
         outs2[0][-2000:]
 
 
-@pytest.mark.slow
 def test_two_process_hierarchical_mesh(tmp_path):
     """Hierarchical (node, local) gossip across 2 processes: exact psum
     averaging inside each node, gossip between nodes, with node boundaries
@@ -135,7 +133,6 @@ def test_two_process_hierarchical_mesh(tmp_path):
     assert os.path.isfile(os.path.join(ckpt_dir, "checkpoint_r1_n8.ckpt"))
 
 
-@pytest.mark.slow
 def test_two_process_orbax_checkpointing(tmp_path):
     """Orbax backend on a 2-process cluster: jax.Array-native global-state
     mode — ONE shared root, every process writes its own shards of the

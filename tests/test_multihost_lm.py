@@ -78,7 +78,6 @@ def _csv_losses(path):
     return [float(r.split(",")[1]) for r in rows]
 
 
-@pytest.mark.slow
 def test_two_process_lm_train_and_resume(tmp_path):
     """Plain gossip-DP LM across 2 processes: trains, writes per-process
     CSVs with finite decreasing-ish loss, then resumes from per-process
@@ -103,7 +102,6 @@ def test_two_process_lm_train_and_resume(tmp_path):
     assert all("resumed from step 8" in o for o in outs2), outs2[0][-2000:]
 
 
-@pytest.mark.slow
 def test_two_process_lm_ring_attention(tmp_path):
     """dp×sp across processes: ring attention's KV rotation crosses the
     host boundary (4 replicas × 2 sequence shards over 2 processes)."""
@@ -116,7 +114,6 @@ def test_two_process_lm_ring_attention(tmp_path):
     assert losses and all(np.isfinite(losses))
 
 
-@pytest.mark.slow
 def test_two_process_lm_ep_tp_orbax(tmp_path):
     """ep×tp across processes: expert all_to_all dispatch and GSPMD
     tensor parallelism crossing the host boundary, with the orbax
@@ -146,7 +143,6 @@ def test_two_process_lm_ep_tp_orbax(tmp_path):
         outs2[0][-2000:]
 
 
-@pytest.mark.slow
 def test_two_process_lm_pp_orbax(tmp_path):
     """dp×pp across processes: the pipeline tick ppermute crosses the
     host boundary, with the orbax global-state checkpoint (stage stacks

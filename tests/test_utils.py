@@ -134,14 +134,29 @@ def test_mpi_multinode_without_coordinator_fails_fast(monkeypatch):
     from stochastic_gradient_push_tpu.parallel.discovery import (
         initialize_multihost)
 
+    import socket
+
     for var in ("SLURM_PROCID", "SLURM_NTASKS", "COORDINATOR_ADDRESS"):
         monkeypatch.delenv(var, raising=False)
+    monkeypatch.delenv("HOSTNAME", raising=False)
     monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
     monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
     monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
     _captured_initialize(monkeypatch)
     with pytest.raises(RuntimeError, match="COORDINATOR_ADDRESS"):
         initialize_multihost()
+
+    # env HOSTNAME == this machine's own name: still a self-dial → raise
+    monkeypatch.setenv("HOSTNAME", socket.gethostname())
+    with pytest.raises(RuntimeError, match="COORDINATOR_ADDRESS"):
+        initialize_multihost()
+
+    # mpirun -x HOSTNAME: rank 0's hostname propagated to a remote node
+    # differs from the machine's own name → trusted as the coordinator
+    monkeypatch.setenv("HOSTNAME", "head-node-from-rank0")
+    got = _captured_initialize(monkeypatch)
+    initialize_multihost()
+    assert got["coordinator_address"] == "head-node-from-rank0:40100"
 
     # single-node (local size == world size): HOSTNAME fallback is fine
     monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "4")
