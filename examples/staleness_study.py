@@ -114,7 +114,7 @@ def run_bilat_sim(mean_delay: float, steps=STEPS, seed=3):
     pairing = build_pairing_schedule(
         DynamicBipartiteExponentialGraph(WORLD))
     x = X0.copy()
-    hist = [x.copy()]
+    hist = []          # end-of-step states of PREVIOUS steps
     spreads, gaps = [], []
     n_phases = pairing.shape[0]
     for t in range(steps):
@@ -126,8 +126,12 @@ def run_bilat_sim(mean_delay: float, steps=STEPS, seed=3):
                                             size=WORLD), 8)
         else:
             delays = np.zeros(WORLD, np.int64)
+        # δ=0 mixes the partner's CURRENT post-update params — exactly
+        # the compiled BilateralGossip's synchronous matching; δ≥1 takes
+        # the partner's end-of-step state from δ steps back
         stale = np.stack([
-            hist[max(0, len(hist) - 1 - int(d))][partners[i]]
+            x[partners[i]] if d == 0 or not hist
+            else hist[max(0, len(hist) - int(d))][partners[i]]
             for i, d in enumerate(delays)])
         x = 0.5 * (x + stale)
         hist.append(x.copy())

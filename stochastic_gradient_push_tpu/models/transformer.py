@@ -56,7 +56,7 @@ class TransformerConfig(tp.NamedTuple):
     d_ff: int = 2048
     max_len: int = 2048
     dtype: tp.Any = jnp.float32
-    attn_impl: str = "full"           # full | blockwise | flash | ring
+    attn_impl: str = "full"     # full | blockwise | flash | ring | ring_flash
     attn_block_size: int = 128        # for blockwise
     seq_axis: str | None = None       # mesh axis for ring attention
     remat: bool = False               # jax.checkpoint each block
@@ -92,6 +92,15 @@ class _Attention(nn.Module):
             if cfg.seq_axis is None:
                 raise ValueError("ring attention requires seq_axis")
             out = ring_attention(q, k, v, cfg.seq_axis, causal=True)
+        elif cfg.attn_impl == "ring_flash":
+            # flash-kernel ticks: O(attn_block_size²) memory per device
+            # regardless of shard length — the long-context production
+            # path (ops/ring_flash.py)
+            if cfg.seq_axis is None:
+                raise ValueError("ring attention requires seq_axis")
+            from ..ops.ring_flash import ring_flash_attention
+            out = ring_flash_attention(q, k, v, cfg.seq_axis, causal=True,
+                                       block=cfg.attn_block_size)
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,
@@ -197,7 +206,7 @@ class TransformerLM(nn.Module):
         if cfg.moe_experts > 0 and cfg.moe_every < 1:
             raise ValueError("moe_every must be >= 1 when moe_experts > 0")
         b, t = tokens.shape
-        if cfg.attn_impl == "ring":
+        if cfg.attn_impl in ("ring", "ring_flash"):
             offset = lax.axis_index(cfg.seq_axis) * t
         else:
             offset = 0

@@ -62,6 +62,16 @@ SCALAR_COLS = 1
 _STATE_LANES = 128
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-mesh-axes type of ``like``
+    — required for pallas_call outputs inside shard_map (check_vma), and
+    the reason ``--attn flash`` can now compile in the sharded LM step."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _compiler_params(interpret: bool):
     """Minor grid dim walks the streamed axis: revisited outputs/scratch
     require ``arbitrary``; the two major dims are parallel."""
@@ -164,12 +174,12 @@ def flash_attention_forward(q, k, v, causal: bool = False,
     out_specs = [
         pl.BlockSpec((None, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
     ]
-    out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
+    out_shape = [_sds((b * h, t, d), q.dtype, qf)]
     if return_lse:
         out_specs.append(pl.BlockSpec((None, block_q, SCALAR_COLS),
                                       lambda bh, qi, kj: (bh, qi, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((b * h, t, SCALAR_COLS),
-                                              jnp.float32))
+        out_shape.append(_sds((b * h, t, SCALAR_COLS), jnp.float32,
+                              qf))
     results = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, t // block_k),
@@ -335,7 +345,7 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
             s_row,                                          # delta
         ],
         out_specs=q_row,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=_sds((b * h, t, d), q.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
@@ -364,8 +374,8 @@ def flash_attention_backward(q, k, v, out, lse, do, causal: bool = False,
         ],
         out_specs=[k_col, k_col],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+            _sds((b * h, t, d), k.dtype, kf),
+            _sds((b * h, t, d), v.dtype, vf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

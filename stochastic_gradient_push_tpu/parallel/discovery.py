@@ -97,7 +97,12 @@ def initialize_multihost(coordinator_address: str | None = None,
                 local = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE",
                                            num_processes))
                 env_host = os.environ.get("HOSTNAME")
-                propagated = env_host not in (None, socket.gethostname())
+                # compare first labels so an FQDN-vs-short mismatch for
+                # the SAME machine (login profiles often export the FQDN)
+                # is not mistaken for a propagated foreign hostname
+                own = socket.gethostname().split(".")[0]
+                propagated = (env_host is not None
+                              and env_host.split(".")[0] != own)
                 if (num_processes > local and process_id > 0
                         and not propagated):
                     raise RuntimeError(

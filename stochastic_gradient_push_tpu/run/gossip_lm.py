@@ -58,7 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d_ff", default=1024, type=int)
     p.add_argument("--seq_len", default=256, type=int)
     p.add_argument("--attn", default=None,
-                   choices=[None, "full", "blockwise", "flash", "ring"],
+                   choices=[None, "full", "blockwise", "flash", "ring",
+                            "ring_flash"],
                    help="default: ring when --sp > 1 else flash on TPU, "
                         "full elsewhere")
     p.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
@@ -308,16 +309,24 @@ def main(argv=None):
             f"--attn flash needs seq_len divisible by "
             f"{min(128, args.seq_len)} (got {args.seq_len}); use "
             "--attn blockwise or a padded seq_len")
-    if sp > 1 and attn != "ring":
+    ring_family = attn in ("ring", "ring_flash")
+    if sp > 1 and not ring_family:
         raise SystemExit("--sp > 1 requires ring attention")
-    if tp > 1 and sp == 1 and attn == "ring":
+    if attn == "ring_flash":
+        shard = args.seq_len // max(1, sp)
+        if not _flash_ok(shard):
+            raise SystemExit(
+                f"--attn ring_flash needs the per-shard length "
+                f"(seq_len/sp = {shard}) divisible by "
+                f"{min(128, shard)}; pad seq_len or use --attn ring")
+    if tp > 1 and sp == 1 and ring_family:
         raise SystemExit(
             "--tp with ring attention requires --sp > 1 (3-D mesh)")
-    if ep > 1 and attn == "ring" and sp == 1:
+    if ep > 1 and ring_family and sp == 1:
         raise SystemExit(
             "--ep with ring attention needs --sp > 1 (the 3-D "
             "gossip × ep × seq mesh)")
-    if pp > 1 and attn == "ring" and sp == 1:
+    if pp > 1 and ring_family and sp == 1:
         raise SystemExit("--pp with ring attention needs --sp > 1 "
                          "(the 3-D gossip × pipe × seq mesh)")
 
@@ -326,7 +335,7 @@ def main(argv=None):
         n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
         max_len=args.seq_len,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
-        attn_impl=attn, seq_axis=SEQ_AXIS if attn == "ring" else None,
+        attn_impl=attn, seq_axis=SEQ_AXIS if ring_family else None,
         remat=sb(args.remat),
         moe_experts=args.moe_experts, moe_every=args.moe_every,
         ep_axis=EP_AXIS if ep > 1 else None)
@@ -369,7 +378,7 @@ def main(argv=None):
     lrs = LRSchedule(ref_lr=args.lr, batch_size=args.batch_size,
                      world_size=dp * ep, decay_schedule={},
                      warmup=sb(args.warmup))
-    ring = attn == "ring"
+    ring = ring_family
     if pp > 1:
         step = build_pp_train_step(model, alg, tx, lrs,
                                    itr_per_epoch=itr_per_epoch)
@@ -385,7 +394,7 @@ def main(argv=None):
     else:
         step = build_lm_train_step(
             model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
-            seq_axis=SEQ_AXIS if attn == "ring" else None,
+            seq_axis=SEQ_AXIS if ring_family else None,
             ep_axis=EP_AXIS if ep > 1 else None)
         if ep > 1:
             state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,

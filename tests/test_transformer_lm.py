@@ -87,6 +87,36 @@ def test_ring_sequence_parallel_forward_matches_single_device():
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
 
 
+def test_ring_flash_forward_matches_single_device():
+    """attn_impl='ring_flash' (flash-kernel ticks, ops/ring_flash.py) is
+    the same function as the single-device full forward."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_dp_sp_mesh(1, 8)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+
+    full = TransformerLM(small_cfg("full"))
+    variables = full.init(jax.random.PRNGKey(0), tokens)
+    want = np.asarray(full.apply(variables, tokens))
+
+    rf = TransformerLM(small_cfg("ring_flash", seq_axis=SEQ_AXIS))
+    block = SEQ // 8
+    sharded_tokens = tokens.reshape(BATCH, 8, block).transpose(1, 0, 2)
+    sharded_tokens = sharded_tokens[None]
+
+    def fwd(params, toks):
+        return rf.apply({"params": params}, toks[0, 0])[None, None]
+
+    f = jax.jit(jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=(P(), P(GOSSIP_AXIS, SEQ_AXIS)),
+        out_specs=P(GOSSIP_AXIS, SEQ_AXIS)))
+    out = np.asarray(f(variables["params"], sharded_tokens))
+    got = out[0].transpose(1, 0, 2, 3).reshape(BATCH, SEQ, VOCAB)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
 @pytest.mark.slow
 def test_gossip_dp_with_ring_sp_trains():
     """4 gossip replicas × 2 sequence shards: loss decreases well below the
