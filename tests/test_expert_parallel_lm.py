@@ -176,6 +176,29 @@ def test_composition_fences_raise_clean_errors():
 
 
 @pytest.mark.slow
+def test_ring_flash_composes_with_pp_sp(tmp_path):
+    """attn=ring_flash inside pipeline ticks (custom-vjp ppermutes in a
+    lax.cond branch of the tick scan) trains end-to-end on the 3-D
+    gossip × pipe × seq mesh."""
+    import subprocess
+    import sys
+
+    from tests.test_run_layer import CLI_ENV
+
+    cmd = [sys.executable, "-m",
+           "stochastic_gradient_push_tpu.run.gossip_lm",
+           "--world_size", "8", "--pp", "2", "--sp", "2",
+           "--attn", "ring_flash", "--seq_len", "64", "--d_model", "32",
+           "--n_layers", "2", "--n_heads", "4", "--d_ff", "32",
+           "--batch_size", "4", "--n_micro", "2", "--num_steps", "4",
+           "--checkpoint_dir", str(tmp_path)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                       env=CLI_ENV)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert '"final_loss"' in r.stdout + r.stderr
+
+
+@pytest.mark.slow
 def test_moe_ep_sp_tp_4d_trains(tmp_path):
     """All four axes at once: gossip × ep × seq × tp on one 4-D mesh,
     with held-out validation through the same composed forward."""
