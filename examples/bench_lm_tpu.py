@@ -59,13 +59,14 @@ def peak_tflops(kind: str) -> float | None:
     return bench.peak_tflops(kind)
 
 
-def run(d_model, n_layers, n_heads, seq, batch, vocab=32000):
+def run(d_model, n_layers, n_heads, seq, batch, vocab=32000,
+        attn="flash"):
     world = jax.device_count()
     mesh = make_gossip_mesh(world)
     cfg = TransformerConfig(
         vocab_size=vocab, d_model=d_model, n_layers=n_layers,
         n_heads=n_heads, d_ff=4 * d_model, max_len=seq,
-        dtype=jnp.bfloat16, attn_impl="flash")
+        dtype=jnp.bfloat16, attn_impl=attn)
     model = TransformerLM(cfg)
     alg = sgp(build_schedule(NPeerDynamicDirectedExponentialGraph(
         world, peers_per_itr=1) if world > 1 else
@@ -124,6 +125,7 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000):
         jax.tree.map(lambda a: a[0], state.params)))
     tokens_per_sec = world * batch * seq / time_per_itr
     out = {"config": f"d{d_model} L{n_layers} h{n_heads} t{seq} b{batch}",
+           "attn": attn,
            "params_m": round(n_params / 1e6, 1), "scan": SCAN,
            "tokens_per_sec_per_chip": round(tokens_per_sec / world),
            "step_ms": round(time_per_itr * 1e3, 2), "loss": round(loss, 3)}
@@ -142,4 +144,12 @@ if __name__ == "__main__":
           flush=True)
     assert backend == "tpu", "needs the real chip"
     for cfg in parse_configs():
-        run(*cfg)
+        # flash vs blockwise per config: isolates the Pallas kernels'
+        # effect on the full train step, and a Mosaic rejection of one
+        # variant cannot strand the other's numbers
+        for attn in ("flash", "blockwise"):
+            try:
+                run(*cfg, attn=attn)
+            except Exception as e:
+                print(json.dumps({"config": str(cfg), "attn": attn,
+                                  "error": repr(e)[:300]}), flush=True)
