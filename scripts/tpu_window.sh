@@ -54,5 +54,24 @@ timeout 900 python examples/bench_lm_tpu.py \
   > "$OUT/lm.txt" 2>"$OUT/lm.err"
 tail -6 "$OUT/lm.txt"
 
+echo "== 4/4 profiler trace of the ResNet step (MFU decomposition) =="
+export TRACE_DIR="$OUT/trace"
+timeout 600 python - > "$OUT/profile.txt" 2>&1 <<'PYEOF'
+# Capture a device trace of a few warmed ResNet-50 SGP steps; the
+# .xplane artifact under docs/tpu_runs/<ts>/trace supports the
+# backward/optimizer attribution BENCH's fwd/fwdbwd probes bracket.
+import os
+os.environ.setdefault("BENCH_BATCH", "128")
+os.environ["BENCH_SCAN"] = "1"
+os.environ["BENCH_STEPS"] = "3"
+os.environ["BENCH_WARMUP"] = "3"
+os.environ["BENCH_AR"] = "0"
+os.environ["BENCH_PHASES"] = "0"
+import jax, bench
+with jax.profiler.trace(os.environ["TRACE_DIR"]):
+    r = bench.run_measurement()
+print(r)
+PYEOF
+
 echo "== done: $OUT =="
 ls -la "$OUT"
