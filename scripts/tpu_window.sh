@@ -40,16 +40,16 @@ case "$KIND" in
 esac
 echo "chip: $KIND" | tee "$OUT/chip.txt"
 
-echo "== 1/3 bench.py (headline) =="
+echo "== 1/4 bench.py (headline) =="
 BENCH_BATCH="${BENCH_BATCH:-128}" BENCH_SCAN="${BENCH_SCAN:-5}" \
   timeout 900 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.jsonl"
 
-echo "== 2/3 flash kernels (numerics + timing vs XLA) =="
+echo "== 2/4 flash kernels (numerics + timing vs XLA) =="
 timeout 900 python examples/bench_flash_tpu.py \
   > "$OUT/flash.txt" 2>"$OUT/flash.err"
 tail -8 "$OUT/flash.txt"
 
-echo "== 3/3 LM bench =="
+echo "== 3/4 LM bench =="
 timeout 900 python examples/bench_lm_tpu.py \
   > "$OUT/lm.txt" 2>"$OUT/lm.err"
 tail -6 "$OUT/lm.txt"
@@ -72,6 +72,7 @@ with jax.profiler.trace(os.environ["TRACE_DIR"]):
     r = bench.run_measurement()
 print(r)
 PYEOF
+tail -4 "$OUT/profile.txt"
 
 echo "== done: $OUT =="
 ls -la "$OUT"
