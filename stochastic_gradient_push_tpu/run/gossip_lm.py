@@ -64,6 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "full elsewhere")
     p.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
     p.add_argument("--remat", default="False", type=str)
+    p.add_argument("--grad_accum", default=1, type=int,
+                   help="microbatches accumulated per optimizer step "
+                        "(1/N peak activation memory; exact — the LM "
+                        "has no BatchNorm). Flat dp/sp/tp/ep meshes "
+                        "only; --pp has n_micro instead")
     # parallelism / run shape
     p.add_argument("--world_size", default=None, type=int)
     p.add_argument("--sp", default=1, type=int,
@@ -329,6 +334,14 @@ def main(argv=None):
     if pp > 1 and ring_family and sp == 1:
         raise SystemExit("--pp with ring attention needs --sp > 1 "
                          "(the 3-D gossip × pipe × seq mesh)")
+    if args.grad_accum > 1 and pp > 1:
+        raise SystemExit("--grad_accum composes with the flat meshes; "
+                         "pipeline runs control microbatching with "
+                         "--n_micro")
+    if args.grad_accum > 1 and args.batch_size % args.grad_accum:
+        raise SystemExit(
+            f"--batch_size {args.batch_size} not divisible by "
+            f"--grad_accum {args.grad_accum}")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -395,7 +408,8 @@ def main(argv=None):
         step = build_lm_train_step(
             model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
             seq_axis=SEQ_AXIS if ring_family else None,
-            ep_axis=EP_AXIS if ep > 1 else None)
+            ep_axis=EP_AXIS if ep > 1 else None,
+            grad_accum=args.grad_accum)
         if ep > 1:
             state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
                                      batch_size=args.batch_size,

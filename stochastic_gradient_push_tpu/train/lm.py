@@ -281,7 +281,8 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                         itr_per_epoch: int,
                         seq_axis: str | None = SEQ_AXIS,
                         ep_axis: str | None = None,
-                        moe_loss_coef: float = 0.01) -> tp.Callable:
+                        moe_loss_coef: float = 0.01,
+                        grad_accum: int = 1) -> tp.Callable:
     """Per-rank LM step ``(state, tokens, targets) -> (state, metrics)``.
 
     Same four-slot structure as the image step (train/step.py); loss is
@@ -292,17 +293,27 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
     expert slices included, since the all_to_all transpose accumulates
     every shard's contribution into them exactly as the implicit psum
     does for replicated leaves.
+
+    ``grad_accum`` splits the batch into that many microbatches scanned
+    sequentially before the optimizer step — 1/grad_accum peak
+    activation memory, the long-context lever alongside remat (the LM
+    has no BatchNorm, so accumulation is EXACTLY equivalent to the full
+    batch; cf. the image step's per-microbatch BN caveat).  MoE caveat:
+    capacity slots are per microbatch (t·cf/E per chunk), so routing
+    with tight capacity can drop differently than full-batch.
     """
+    if grad_accum < 1:
+        raise ValueError("grad_accum must be >= 1")
 
     def train_step(state: TrainState, tokens, targets):
         params, gstate = algorithm.pre_step(state.params, state.gossip)
         z = algorithm.eval_params(params, gstate)
 
-        def loss_fn(p):
+        def loss_fn(p, toks, tgts):
             logits, mutated = model.apply(
-                {"params": p}, tokens, train=True,
+                {"params": p}, toks, train=True,
                 mutable=["losses", "moe_metrics"])
-            ce = lm_loss(logits, targets)
+            ce = lm_loss(logits, tgts)
             loss = ce
             sown = jax.tree.leaves(mutated.get("losses", {}))
             if sown:
@@ -313,8 +324,36 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                        if dropped else jnp.float32(0.0))
             return loss, (ce, dropped)
 
-        (loss, (ce, dropped)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(z)
+        if grad_accum == 1:
+            (loss, (ce, dropped)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(z, tokens, targets)
+        else:
+            b = tokens.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum {grad_accum}")
+            micro = b // grad_accum
+            xs = tokens.reshape((grad_accum, micro) + tokens.shape[1:])
+            ys = targets.reshape((grad_accum, micro) + targets.shape[1:])
+
+            def accum(carry, xy):
+                g_sum, loss_sum, ce_sum, drop_sum = carry
+                toks, tgts = xy
+                (l, (c, d)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(z, toks, tgts)
+                return (jax.tree.map(jnp.add, g_sum, g), loss_sum + l,
+                        ce_sum + c, drop_sum + d), None
+
+            zero_g = jax.tree.map(jnp.zeros_like, z)
+            # scalar accumulators derive from the (device-varying) tokens
+            # so the scan carry type matches the body outputs (vma rules)
+            zero_s = jnp.sum(tokens * 0.0).astype(jnp.float32)
+            (g_sum, loss, ce, dropped), _ = lax.scan(
+                accum, (zero_g, zero_s, zero_s, zero_s), (xs, ys))
+            grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
+            loss = loss / grad_accum
+            ce = ce / grad_accum
+            dropped = dropped / grad_accum
 
         if seq_axis is not None:
             # params are invariant over seq → autodiff psums grads over the

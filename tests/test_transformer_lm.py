@@ -320,3 +320,136 @@ def test_lm_cli_validation(tmp_path):
         assert val_rows, csv
         for l in val_rows:
             assert np.isfinite(float(l.split(",")[5]))
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum splits the batch into scanned microbatches; the LM has
+    no BatchNorm, so one accumulated step must equal the full-batch step
+    EXACTLY (params, loss, grad_norm)."""
+    from stochastic_gradient_push_tpu.algorithms import all_reduce
+    from stochastic_gradient_push_tpu.train.lm import (
+        init_lm_state, shard_lm_train_step)
+
+    dp = 2
+    mesh = make_dp_sp_mesh(dp, 1)
+    cfg = small_cfg("full")
+    model = TransformerLM(cfg)
+    alg = all_reduce(GOSSIP_AXIS)
+    tx = sgd(momentum=0.0, weight_decay=0.0)
+    lrs = LRSchedule(ref_lr=0.1, batch_size=4, world_size=dp,
+                     decay_schedule={}, warmup=False)
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, VOCAB, size=(dp, 4, SEQ)).astype(np.int32)
+    tgts = rng.integers(0, VOCAB, size=(dp, 4, SEQ)).astype(np.int32)
+
+    results = {}
+    for ga in (1, 2, 4):
+        step = build_lm_train_step(model, alg, tx, lrs,
+                                   itr_per_epoch=100, seq_axis=None,
+                                   grad_accum=ga)
+        state = init_lm_state(model, mesh, alg, tx, dp=dp, sp=1,
+                              batch_size=4, block_len=SEQ, seq_axis=None)
+        fn = shard_lm_train_step(step, mesh, seq_axis=None)
+        new_state, metrics = fn(state, toks, tgts)
+        results[ga] = (jax.tree.map(np.asarray, new_state.params),
+                       float(np.asarray(metrics["loss"])[0]),
+                       float(np.asarray(metrics["grad_norm"])[0]))
+
+    for ga in (2, 4):
+        np.testing.assert_allclose(results[ga][1], results[1][1],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(results[ga][2], results[1][2],
+                                   rtol=1e-4, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(results[ga][0]),
+                        jax.tree.leaves(results[1][0])):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_grad_accum_matches_full_batch_on_sp_mesh():
+    """Ring-attention collectives inside the accumulation scan: one
+    grad_accum=2 step on the (gossip, seq) mesh equals the full-batch
+    step exactly."""
+    from jax.sharding import PartitionSpec as P
+
+    from stochastic_gradient_push_tpu.algorithms import all_reduce
+    from stochastic_gradient_push_tpu.train.lm import (
+        init_lm_state, shard_lm_train_step)
+
+    dp, sp = 2, 2
+    block = SEQ // sp
+    mesh = make_dp_sp_mesh(dp, sp)
+    cfg = small_cfg("ring", seq_axis=SEQ_AXIS)
+    model = TransformerLM(cfg)
+    alg = all_reduce(GOSSIP_AXIS)
+    tx = sgd(momentum=0.0, weight_decay=0.0)
+    lrs = LRSchedule(ref_lr=0.1, batch_size=4, world_size=dp * sp,
+                     decay_schedule={}, warmup=False)
+    rng = np.random.default_rng(6)
+    toks = rng.integers(0, VOCAB, size=(dp, sp, 4, block)).astype(np.int32)
+    tgts = rng.integers(0, VOCAB, size=(dp, sp, 4, block)).astype(np.int32)
+
+    results = {}
+    for ga in (1, 2):
+        step = build_lm_train_step(model, alg, tx, lrs,
+                                   itr_per_epoch=100, seq_axis=SEQ_AXIS,
+                                   grad_accum=ga)
+        state = init_lm_state(model, mesh, alg, tx, dp=dp, sp=sp,
+                              batch_size=4, block_len=block)
+        fn = shard_lm_train_step(step, mesh, seq_axis=SEQ_AXIS)
+        new_state, metrics = fn(state, toks, tgts)
+        results[ga] = (jax.tree.map(np.asarray, new_state.params),
+                       float(np.asarray(metrics["loss"])[0]))
+
+    np.testing.assert_allclose(results[2][1], results[1][1],
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(results[2][0]),
+                    jax.tree.leaves(results[1][0])):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_grad_accum_matches_full_batch_on_ep_mesh():
+    """MoE all_to_all dispatch inside the accumulation scan: with
+    no-drop capacity and moe_loss_coef=0 (the LB loss is nonlinear in
+    the batch split), grad_accum=2 on the (gossip, ep) mesh equals the
+    full-batch step exactly."""
+    from stochastic_gradient_push_tpu.algorithms import all_reduce
+    from stochastic_gradient_push_tpu.train.lm import (
+        EP_AXIS, ep_state_specs, init_lm_state_ep, shard_lm_train_step)
+
+    dp, ep = 1, 2
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=D, n_layers=LAYERS, n_heads=HEADS,
+        d_ff=64, max_len=SEQ, attn_impl="full", moe_experts=4,
+        moe_every=2, moe_capacity_factor=8.0, ep_axis=EP_AXIS)
+    model = TransformerLM(cfg)
+    from stochastic_gradient_push_tpu.train.lm import make_dp_ep_mesh
+    mesh = make_dp_ep_mesh(dp, ep)
+    alg = all_reduce(GOSSIP_AXIS)
+    tx = sgd(momentum=0.0, weight_decay=0.0)
+    lrs = LRSchedule(ref_lr=0.1, batch_size=4, world_size=dp * ep,
+                     decay_schedule={}, warmup=False)
+    rng = np.random.default_rng(8)
+    toks = rng.integers(0, VOCAB, size=(dp, ep, 4, SEQ)).astype(np.int32)
+    tgts = rng.integers(0, VOCAB, size=(dp, ep, 4, SEQ)).astype(np.int32)
+
+    results = {}
+    for ga in (1, 2):
+        step = build_lm_train_step(model, alg, tx, lrs,
+                                   itr_per_epoch=100, seq_axis=None,
+                                   ep_axis=EP_AXIS, moe_loss_coef=0.0,
+                                   grad_accum=ga)
+        state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
+                                 batch_size=4, seq_len=SEQ)
+        fn = shard_lm_train_step(step, mesh, seq_axis=None,
+                                 state_specs=ep_state_specs(state),
+                                 ep_axis=EP_AXIS)
+        new_state, metrics = fn(state, toks, tgts)
+        assert float(np.asarray(metrics["moe_dropped"])[0]) == 0.0
+        results[ga] = (jax.tree.map(np.asarray, new_state.params),
+                       float(np.asarray(metrics["loss"])[0]))
+
+    np.testing.assert_allclose(results[2][1], results[1][1],
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(results[2][0]),
+                    jax.tree.leaves(results[1][0])):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
