@@ -1,0 +1,12 @@
+#!/bin/bash
+# Build the native C++ data-loader extension out-of-band (the normal path
+# is on-demand: data/native.py::ensure_built compiles it on first use).
+set -eu
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+python - <<'PYEOF'
+from stochastic_gradient_push_tpu.data.native import ensure_built
+so = ensure_built(verbose=True)
+if so is None:
+    raise SystemExit("native loader build failed (needs g++ and libjpeg)")
+print(f"built: {so}")
+PYEOF

@@ -2,6 +2,7 @@
 
 from .imagefolder import ImageFolderDataset, load_image, scan_image_folder
 from .lm import lm_batches, synthetic_lm_corpus
+from .native import NativeDecoder
 from .streaming import StreamingImageFolder
 from .pipeline import (
     DistributedSampler,
@@ -20,6 +21,7 @@ __all__ = [
     "synthetic_lm_corpus",
     "lm_batches",
     "ImageFolderDataset",
+    "NativeDecoder",
     "StreamingImageFolder",
     "scan_image_folder",
     "load_image",

@@ -19,7 +19,8 @@ import os
 
 import numpy as np
 
-__all__ = ["scan_image_folder", "load_image", "ImageFolderDataset"]
+__all__ = ["scan_image_folder", "load_image", "ImageFolderDataset",
+           "augmentation_rng"]
 
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
@@ -42,6 +43,15 @@ def scan_image_folder(root: str) -> tuple[list[str], np.ndarray, list[str]]:
     if not paths:
         raise FileNotFoundError(f"no images under {root}")
     return paths, np.asarray(labels, np.int32), classes
+
+
+def augmentation_rng(seed: int, epoch: int, idx: int) -> np.random.Generator:
+    """The per-(seed, epoch, sample) augmentation stream: deterministic but
+    fresh crops every epoch.  ONE derivation shared by the PIL path and the
+    native decoder (data/native.py) — backend interchangeability depends on
+    both drawing from the identical stream."""
+    return np.random.default_rng(
+        (seed * 1_000_003 + epoch) * 10_000_019 + int(idx))
 
 
 def _random_resized_crop_box(w: int, h: int, rng: np.random.Generator,
@@ -118,10 +128,7 @@ class ImageFolderDataset:
         self.epoch = int(epoch)
 
     def __getitem__(self, idx: int) -> tuple[np.ndarray, np.int32]:
-        # per-(epoch, sample) augmentation stream: deterministic but fresh
-        # crops every epoch
-        rng = (np.random.default_rng(
-            (self.seed * 1_000_003 + self.epoch) * 10_000_019 + int(idx))
-            if self.train else None)
+        rng = (augmentation_rng(self.seed, self.epoch, idx)
+               if self.train else None)
         return (load_image(self.paths[idx], self.image_size, self.train,
                            rng), self.labels[idx])

@@ -1,0 +1,212 @@
+"""Python face of the native C++ image pipeline (data/native_src/loader.cc).
+
+The reference feeds each worker from torch's C++ DataLoader machinery
+(gossip_sgd.py:563-567, ``num_workers`` forked decoders); the TPU framework's
+counterpart is a CPython extension that decodes, resamples and normalizes
+whole batches with the GIL released on a std::thread pool.  This module:
+
+* builds the extension on demand (``g++ -O3 -shared``, cached next to the
+  source; no pybind11 — the image doesn't have it);
+* samples the augmentation stream IN PYTHON, with exactly the per-
+  ``(seed, epoch, index)`` rng of :class:`~.imagefolder.ImageFolderDataset`,
+  so the native and PIL paths see identical crops/flips; pixel values
+  match PIL to ~1 uint8 LSB at ``max_denom=1`` (parity-tested), while the
+  default ``max_denom=8`` trades that for DCT-domain fast decodes on
+  large images — a faithful antialiased downscale, not LSB-identical;
+* decodes anything the C++ side rejects (PNG, CMYK, truncated files)
+  through the PIL fallback, per image, so correctness never depends on the
+  native path being available.
+
+``SGP_NATIVE_LOADER=0`` disables the extension entirely (the streaming
+loader then uses pure PIL); ``=require`` turns a missing toolchain into an
+error instead of a silent fallback.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+import typing as tp
+
+import numpy as np
+
+from .imagefolder import (_random_resized_crop_box, augmentation_rng,
+                          load_image)
+
+__all__ = ["ensure_built", "get_native", "NativeDecoder"]
+
+_DATA_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DATA_DIR, "native_src", "loader.cc")
+_SO = os.path.join(_DATA_DIR, "_nativeloader.so")
+_LOCK = threading.Lock()
+_MODULE: tp.Any = None
+_TRIED = False
+
+
+def ensure_built(verbose: bool = False) -> str | None:
+    """Compile the extension if missing/stale; return the .so path or None."""
+    if os.path.exists(_SO):
+        # a shipped prebuilt .so without the source tree is fine as-is
+        if not os.path.exists(_SRC) or \
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+    if not os.path.exists(_SRC):
+        return None
+    include = sysconfig.get_paths()["include"]
+    tmp = _SO + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", f"-I{include}",
+           _SRC, "-o", tmp, "-ljpeg", "-pthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no g++ / hang
+        if verbose:
+            print(f"native loader build unavailable: {e}", file=sys.stderr)
+        try:
+            os.unlink(tmp)  # a timed-out g++ may leave a partial object
+        except OSError:
+            pass
+        return None
+    if proc.returncode != 0:
+        if verbose:
+            print(f"native loader build failed:\n{proc.stderr}",
+                  file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    os.replace(tmp, _SO)  # atomic: concurrent builders race harmlessly
+    return _SO
+
+
+def get_native() -> tp.Any | None:
+    """Import (building if needed) the `_nativeloader` module, else None."""
+    global _MODULE, _TRIED
+    with _LOCK:
+        if _MODULE is not None or _TRIED:
+            return _MODULE
+        _TRIED = True
+        mode = os.environ.get("SGP_NATIVE_LOADER", "1").lower()
+        if mode in ("0", "off", "false"):
+            return None
+        so = ensure_built(verbose=(mode == "require"))
+        if so is None:
+            if mode == "require":
+                raise RuntimeError(
+                    "SGP_NATIVE_LOADER=require but the native loader could "
+                    "not be built (g++/libjpeg missing?)")
+            return None
+        spec = importlib.util.spec_from_file_location("_nativeloader", so)
+        assert spec and spec.loader
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _MODULE = mod
+        return _MODULE
+
+
+class NativeDecoder:
+    """Batch decoder with the exact augmentation stream of
+    :class:`~.imagefolder.ImageFolderDataset`.
+
+    Crop boxes / flips are sampled here (numpy rng, per ``(seed, epoch,
+    index)``) against header-only image dimensions (cached after first
+    touch — no pixel decode), then the C++ pool does decode + resample +
+    normalize straight into the output buffer.  Failed indices fall back
+    to :func:`~.imagefolder.load_image`.
+    """
+
+    def __init__(self, paths: tp.Sequence[str], image_size: int,
+                 train: bool, seed: int = 0,
+                 threads: int | None = None, max_denom: int = 8):
+        self.paths = list(paths)
+        self.image_size = int(image_size)
+        self.train = bool(train)
+        self.seed = int(seed)
+        self.epoch = 0
+        self.threads = threads or min(16, os.cpu_count() or 1)
+        # DCT-domain downscale cap; 1 disables (exact-parity mode for tests)
+        self.max_denom = int(max_denom)
+        # header dims cache: (n, 2) int32, -1 = not yet read (a dict of
+        # tuples would cost hundreds of MB at ImageNet scale)
+        self._dims = np.full((len(self.paths), 2), -1, np.int32)
+        self._native = get_native()
+
+    @property
+    def available(self) -> bool:
+        return self._native is not None
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def _dims_for(self, idx: int) -> tuple[int, int]:
+        w, h = self._dims[idx]
+        if w < 0:
+            from PIL import Image
+            with Image.open(self.paths[idx]) as im:  # header only, no decode
+                w, h = im.size
+            self._dims[idx] = (w, h)
+        return int(w), int(h)
+
+    def _rng(self, idx: int) -> np.random.Generator:
+        # identical stream to ImageFolderDataset.__getitem__
+        return augmentation_rng(self.seed, self.epoch, idx)
+
+    def sample_boxes(self, indices: np.ndarray) -> np.ndarray:
+        """(n, 5) int32 (l, t, w, h, flip); eval rows are the sentinel."""
+        n = len(indices)
+        boxes = np.empty((n, 5), np.int32)
+        if not self.train:
+            boxes[:] = (-1, -1, -1, -1, 0)
+            return boxes
+        for j, idx in enumerate(indices):
+            w, h = self._dims_for(int(idx))
+            rng = self._rng(int(idx))
+            l, t, cw, ch = _random_resized_crop_box(w, h, rng)
+            boxes[j] = (l, t, cw, ch, 1 if rng.random() < 0.5 else 0)
+        return boxes
+
+    def decode(self, indices: np.ndarray,
+               out: np.ndarray | None = None) -> np.ndarray:
+        """Decode ``indices`` -> float32 (n, S, S, 3), normalized."""
+        indices = np.asarray(indices).reshape(-1)
+        n, S = len(indices), self.image_size
+        if out is None:
+            out = np.empty((n, S, S, 3), np.float32)
+        assert out.shape == (n, S, S, 3) and out.dtype == np.float32
+        if self._native is None:
+            self._pil_many(indices, range(len(indices)), out)
+            return out
+        boxes = self.sample_boxes(indices)
+        paths = [os.fsencode(self.paths[int(i)]) for i in indices]
+        failed = self._native.decode_batch(paths, boxes, out, S,
+                                           self.threads, True,
+                                           self.max_denom)
+        # anything libjpeg rejected (PNG/webp/CMYK/truncated) decodes via
+        # PIL — threaded, so a mostly-non-JPEG dataset keeps its decode
+        # parallelism instead of collapsing to a serial loop
+        self._pil_many(indices, failed, out)
+        return out
+
+    def _pil_many(self, indices: np.ndarray, slots: tp.Iterable[int],
+                  out: np.ndarray) -> None:
+        slots = list(slots)
+        if len(slots) <= 1 or self.threads == 1:
+            for j in slots:
+                out[j] = self._pil_one(int(indices[j]))
+            return
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(self.threads, len(slots))) as pool:
+            for j, img in zip(slots, pool.map(
+                    lambda j: self._pil_one(int(indices[j])), slots)):
+                out[j] = img
+
+    def _pil_one(self, idx: int) -> np.ndarray:
+        return load_image(self.paths[idx], self.image_size, self.train,
+                          self._rng(idx) if self.train else None)

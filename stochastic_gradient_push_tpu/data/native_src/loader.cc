@@ -1,0 +1,530 @@
+// Native host-side image pipeline: the TPU-framework counterpart of the
+// reference's C++ decode path (torch's DataLoader workers + torchvision's
+// libjpeg-backed PIL decode, driven from gossip_sgd.py:546-583).
+//
+// The reference feeds each GPU from forked C++ DataLoader workers; a TPU
+// chip at the measured 2600 img/s/chip (BASELINE.md) outruns a Python/PIL
+// decode loop by an order of magnitude, so the host pipeline must be
+// native too.  This module is a CPython extension (no pybind11 in the
+// image — raw C API + buffer protocol, no numpy C API) that does, per
+// image, entirely in C++ with the GIL released:
+//
+//   JPEG decode (libjpeg)  ->  crop  ->  separable triangle-filter
+//   resample (Pillow-compatible BILINEAR, antialiased on downscale)
+//   ->  horizontal flip  ->  float32 normalize (ImageNet mean/std)
+//
+// Both transform orders of data/imagefolder.py are reproduced exactly:
+//   train:  crop(box) -> resize(S,S) -> optional flip      (load_image)
+//   eval:   resize(short->256S/224) -> center-crop(S)      (load_image)
+// Crop boxes and flips are SAMPLED IN PYTHON (imagefolder.py keeps its
+// per-(epoch,sample) rng) and passed in, so native and PIL paths see
+// identical augmentation streams and differ only in resampling rounding.
+//
+// Batch API: decode_batch() fans a list of file paths over an internal
+// std::thread pool and writes straight into a caller-provided float32
+// buffer (world, batch, S, S, 3)-shaped by the Python wrapper.  Images
+// that libjpeg cannot handle (PNG, CMYK/YCCK, truncated files) are
+// reported back by index and re-decoded through the PIL fallback —
+// correctness never depends on this module.
+//
+// Build: scripts/build_native.sh or data/native.py:ensure_built()
+// (g++ -O3 -shared -fPIC loader.cc -ljpeg).
+
+#include <Python.h>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// JPEG decode (libjpeg, error-trampoline instead of exit())
+// ---------------------------------------------------------------------------
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  std::longjmp(err->jump, 1);
+}
+
+// Decoded image: tightly packed RGB, uint8.  full_w/full_h are the
+// original (pre-scale_denom) dimensions straight from the header —
+// libjpeg rounds scaled output dims UP, so w * denom may overshoot.
+struct Image {
+  int w = 0, h = 0;
+  int full_w = 0, full_h = 0;
+  std::vector<uint8_t> rgb;  // h * w * 3
+  bool ok = false;
+};
+
+// One libjpeg session: read the header, let ``pick_denom`` choose the
+// DCT-domain downscale (1, 2, 4, 8 — the cheap 1/scale_denom decode) from
+// the full-size dims, then decompress.  ``denom_out`` reports the choice.
+// CMYK / YCCK (which PIL converts via ImageCms) and non-3-component
+// outputs are routed to the Python fallback.
+template <typename PickDenom>
+Image decode_jpeg(const uint8_t* data, size_t len, PickDenom pick_denom,
+                  int* denom_out) {
+  Image img;
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    img.ok = false;
+    return img;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return img;
+  }
+  if (cinfo.jpeg_color_space == JCS_CMYK ||
+      cinfo.jpeg_color_space == JCS_YCCK) {
+    jpeg_destroy_decompress(&cinfo);
+    return img;
+  }
+  const int denom = pick_denom(static_cast<int>(cinfo.image_width),
+                               static_cast<int>(cinfo.image_height));
+  *denom_out = denom;
+  img.full_w = static_cast<int>(cinfo.image_width);
+  img.full_h = static_cast<int>(cinfo.image_height);
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = static_cast<unsigned>(denom);
+  cinfo.dct_method = JDCT_ISLOW;  // match PIL's default quality
+  jpeg_start_decompress(&cinfo);
+  img.w = static_cast<int>(cinfo.output_width);
+  img.h = static_cast<int>(cinfo.output_height);
+  if (cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return img;
+  }
+  img.rgb.resize(static_cast<size_t>(img.w) * img.h * 3);
+  const size_t stride = static_cast<size_t>(img.w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = img.rgb.data() + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  img.ok = true;
+  return img;
+}
+
+// ---------------------------------------------------------------------------
+// Pillow-compatible separable resampling (BILINEAR == triangle filter,
+// antialiased when downscaling: support scales with in/out ratio).
+// Matches Pillow's ResampleHorizontal/Vertical coefficient construction;
+// we keep float32 throughout (Pillow quantizes to int16 fixed point, so
+// outputs differ by <=1-2 LSB — parity-tested in
+// tests/test_native_loader.py).
+// ---------------------------------------------------------------------------
+
+struct FilterTable {
+  int ksize = 0;                 // max taps per output pixel
+  std::vector<int> bounds;       // 2 * out: (xmin, xcount)
+  std::vector<float> coeffs;     // out * ksize
+};
+
+FilterTable triangle_coeffs(int in_size, int out_size, double box_start,
+                            double box_size) {
+  FilterTable ft;
+  const double scale = box_size / out_size;
+  const double filterscale = std::max(scale, 1.0);
+  const double support = 1.0 * filterscale;  // bilinear support = 1.0
+  ft.ksize = static_cast<int>(std::ceil(support)) * 2 + 1;
+  ft.bounds.resize(2 * out_size);
+  ft.coeffs.assign(static_cast<size_t>(out_size) * ft.ksize, 0.0f);
+  const double ss = 1.0 / filterscale;
+  for (int xx = 0; xx < out_size; ++xx) {
+    const double center = box_start + (xx + 0.5) * scale;
+    int xmin = static_cast<int>(center - support + 0.5);
+    if (xmin < 0) xmin = 0;
+    int xmax = static_cast<int>(center + support + 0.5);
+    if (xmax > in_size) xmax = in_size;
+    xmax -= xmin;
+    double wsum = 0.0;
+    std::vector<double> w(static_cast<size_t>(std::max(xmax, 1)));
+    for (int x = 0; x < xmax; ++x) {
+      const double arg = (xmin + x - center + 0.5) * ss;
+      const double v = (arg >= -1.0 && arg <= 1.0)
+                           ? (arg < 0 ? 1.0 + arg : 1.0 - arg)
+                           : 0.0;
+      w[static_cast<size_t>(x)] = v;
+      wsum += v;
+    }
+    for (int x = 0; x < xmax; ++x) {
+      ft.coeffs[static_cast<size_t>(xx) * ft.ksize + x] =
+          wsum != 0.0 ? static_cast<float>(w[static_cast<size_t>(x)] / wsum)
+                      : 0.0f;
+    }
+    ft.bounds[2 * xx] = xmin;
+    ft.bounds[2 * xx + 1] = xmax;
+  }
+  return ft;
+}
+
+// Finalization applied as each output row completes: clamp to [0, 255],
+// round to the uint8 grid PIL materializes, optional horizontal flip,
+// optional ImageNet normalize, write float32.
+struct Finalize {
+  bool flip = false;
+  bool normalize = true;
+  int out_w = 0;      // row width of dst
+  float* dst = nullptr;
+};
+
+constexpr float kMean[3] = {0.485f, 0.456f, 0.406f};
+constexpr float kStd[3] = {0.229f, 0.224f, 0.225f};
+
+// Resample a (h, w, 3) uint8 image to the conceptual (out_h, out_w) grid,
+// but only materialize the output window [x0, x1) x [y0, y1) — EXACT:
+// every produced pixel reads the same source taps it would in a full
+// resample, so a windowed eval (resize-short then center-crop) is
+// bit-identical to resize-then-crop.  box_* give the source rectangle in
+// decoded coords (train crop / full image).  Each finished row runs
+// through ``fin`` straight into the caller's buffer; nothing the window
+// doesn't need is ever computed.
+void resample_window(const uint8_t* src, int w, int h, double box_l,
+                     double box_t, double box_w, double box_h, int out_w,
+                     int out_h, int x0, int x1, int y0, int y1,
+                     const Finalize& fin) {
+  const FilterTable fx = triangle_coeffs(w, out_w, box_l, box_w);
+  const FilterTable fy = triangle_coeffs(h, out_h, box_t, box_h);
+  const int ww = x1 - x0;
+  // source rows the vertical pass will touch:
+  const int row_lo = fy.bounds[2 * y0];
+  const int row_hi = fy.bounds[2 * (y1 - 1)] + fy.bounds[2 * (y1 - 1) + 1];
+  const int nrows = row_hi - row_lo;
+  // horizontal pass over just those rows and just the window's columns
+  std::vector<float> tmp(static_cast<size_t>(nrows) * ww * 3);
+  for (int y = 0; y < nrows; ++y) {
+    const uint8_t* row = src + static_cast<size_t>(row_lo + y) * w * 3;
+    float* trow = tmp.data() + static_cast<size_t>(y) * ww * 3;
+    for (int xx = x0; xx < x1; ++xx) {
+      const int xmin = fx.bounds[2 * xx];
+      const int xcount = fx.bounds[2 * xx + 1];
+      const float* cf = fx.coeffs.data() + static_cast<size_t>(xx) * fx.ksize;
+      float r = 0, g = 0, b = 0;
+      for (int x = 0; x < xcount; ++x) {
+        const float c = cf[x];
+        const uint8_t* px = row + static_cast<size_t>(xmin + x) * 3;
+        r += c * px[0];
+        g += c * px[1];
+        b += c * px[2];
+      }
+      float* o = trow + static_cast<size_t>(xx - x0) * 3;
+      o[0] = r;
+      o[1] = g;
+      o[2] = b;
+    }
+  }
+  // vertical pass + fused finalize, one output row at a time
+  std::vector<float> acc(static_cast<size_t>(ww) * 3);
+  for (int yy = y0; yy < y1; ++yy) {
+    const int ymin = fy.bounds[2 * yy];
+    const int ycount = fy.bounds[2 * yy + 1];
+    const float* cf = fy.coeffs.data() + static_cast<size_t>(yy) * fy.ksize;
+    std::memset(acc.data(), 0, sizeof(float) * ww * 3);
+    for (int y = 0; y < ycount; ++y) {
+      const float c = cf[y];
+      const float* trow =
+          tmp.data() + static_cast<size_t>(ymin - row_lo + y) * ww * 3;
+      for (int x = 0; x < ww * 3; ++x) acc[static_cast<size_t>(x)] += c * trow[x];
+    }
+    float* drow = fin.dst + static_cast<size_t>(yy - y0) * fin.out_w * 3;
+    for (int x = 0; x < ww; ++x) {
+      const int sx = fin.flip ? (ww - 1 - x) : x;
+      for (int c = 0; c < 3; ++c) {
+        float v = acc[static_cast<size_t>(sx) * 3 + c];
+        v = std::min(std::max(v, 0.0f), 255.0f);
+        v = std::nearbyintf(v);  // PIL's uint8 quantization
+        drow[3 * x + c] = fin.normalize
+                              ? (v / 255.0f - kMean[c]) / kStd[c]
+                              : v / 255.0f;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Per-image pipeline
+// ---------------------------------------------------------------------------
+
+bool read_file(const char* path, std::vector<uint8_t>& buf) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  const long n = std::ftell(f);
+  if (n < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  buf.resize(static_cast<size_t>(n));
+  const size_t got = n ? std::fread(buf.data(), 1, static_cast<size_t>(n), f)
+                       : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(n);
+}
+
+struct Task {
+  const char* path;
+  // train: crop box in ORIGINAL image coords (from Python's rng);
+  // negative box_w means eval mode (resize-short + center-crop).
+  int box_l, box_t, box_w, box_h;
+  int flip;       // train only
+  int out_size;   // S
+  int max_denom;  // cap on the DCT-domain downscale (1 disables)
+  float* dst;     // S*S*3 float32, normalized
+};
+
+bool run_task(const Task& t, bool normalize) {
+  std::vector<uint8_t> raw;
+  if (!read_file(t.path, raw)) return false;
+  // JPEG magic; everything else goes to the Python fallback.
+  if (raw.size() < 3 || raw[0] != 0xFF || raw[1] != 0xD8) return false;
+
+  const bool train = t.box_w >= 0;
+
+  // DCT-domain scale_denom choice: decoding at 1/2 or 1/4 is far cheaper
+  // and stays lossless for the filter as long as the decoded source
+  // region never drops below the resample target (the triangle filter
+  // then still strictly downscales, so antialiasing stays intact).
+  int denom = 1;
+  auto pick = [&](int w, int h) {
+    const int src_min =
+        train ? std::min(t.box_w, t.box_h) : std::min(w, h);
+    const int target = train
+        ? t.out_size
+        : (t.out_size * 256 + 223) / 224;  // eval short-side target
+    int d = 1;
+    for (int cand = 2; cand <= t.max_denom; cand *= 2) {
+      if (src_min / cand >= target) d = cand;
+    }
+    return d;
+  };
+  Image img = decode_jpeg(raw.data(), raw.size(), pick, &denom);
+  if (!img.ok) return false;
+  const double ds = 1.0 / denom;  // original -> decoded coord scale
+
+  const int S = t.out_size;
+  Finalize fin;
+  fin.normalize = normalize;
+  fin.out_w = S;
+  fin.dst = t.dst;
+  if (train) {
+    fin.flip = t.flip != 0;
+    resample_window(img.rgb.data(), img.w, img.h, t.box_l * ds, t.box_t * ds,
+                    t.box_w * ds, t.box_h * ds, S, S, 0, S, 0, S, fin);
+  } else {
+    // Resize short side to round(256/224*S) keeping aspect (exactly
+    // imagefolder.py:88-94), then center-crop SxS — windowed, so only the
+    // crop region (plus filter support) is ever resampled.
+    const int short_target = static_cast<int>(S * 256.0 / 224.0);
+    int nw, nh;
+    // NOTE: imagefolder.py computes from ORIGINAL dims; use the header's
+    // full_w/full_h (w * denom would overshoot — libjpeg ceils scaled
+    // dims), then map the resample onto the 1/denom-scaled decode.
+    // nearbyint under the default FE_TONEAREST mode rounds half-to-even,
+    // matching Python's round() in imagefolder.py:91-93 for exact .5s
+    const int ow = img.full_w, oh = img.full_h;
+    if (ow <= oh) {
+      nw = short_target;
+      nh = std::max(short_target,
+                    static_cast<int>(std::nearbyint(
+                        static_cast<double>(short_target) * oh / ow)));
+    } else {
+      nh = short_target;
+      nw = std::max(short_target,
+                    static_cast<int>(std::nearbyint(
+                        static_cast<double>(short_target) * ow / oh)));
+    }
+    const int left = (nw - S) / 2, top = (nh - S) / 2;
+    resample_window(img.rgb.data(), img.w, img.h, 0.0, 0.0,
+                    static_cast<double>(img.w), static_cast<double>(img.h),
+                    nw, nh, left, left + S, top, top + S, fin);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Python bindings (raw C API, buffer protocol only)
+// ---------------------------------------------------------------------------
+
+struct BufferGuard {
+  Py_buffer view{};
+  bool held = false;
+  ~BufferGuard() {
+    if (held) PyBuffer_Release(&view);
+  }
+};
+
+bool get_buffer(PyObject* obj, BufferGuard& g, int flags, const char* name) {
+  if (PyObject_GetBuffer(obj, &g.view, flags) != 0) {
+    PyErr_Format(PyExc_TypeError, "%s must support the buffer protocol",
+                 name);
+    return false;
+  }
+  g.held = true;
+  if (!PyBuffer_IsContiguous(&g.view, 'C')) {
+    PyErr_Format(PyExc_ValueError, "%s must be C-contiguous", name);
+    return false;
+  }
+  return true;
+}
+
+// decode_batch(paths: list[bytes], boxes: int32 buffer (n, 5) =
+//   (box_l, box_t, box_w, box_h, flip) with box_w < 0 => eval,
+//   out: float32 buffer (n * S * S * 3), out_size: int, threads: int,
+//   normalize: bool) -> list[int]   (indices that need the PIL fallback)
+PyObject* py_decode_batch(PyObject*, PyObject* args) {
+  PyObject* paths_obj;
+  PyObject* boxes_obj;
+  PyObject* out_obj;
+  int out_size, threads, normalize, max_denom = 8;
+  if (!PyArg_ParseTuple(args, "OOOiip|i", &paths_obj, &boxes_obj, &out_obj,
+                        &out_size, &threads, &normalize, &max_denom)) {
+    return nullptr;
+  }
+  if (!PyList_Check(paths_obj)) {
+    PyErr_SetString(PyExc_TypeError, "paths must be a list of bytes");
+    return nullptr;
+  }
+  const Py_ssize_t n = PyList_GET_SIZE(paths_obj);
+
+  // hold the path bytes (borrowed refs stay alive via the list)
+  std::vector<const char*> paths(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GET_ITEM(paths_obj, i);
+    if (!PyBytes_Check(item)) {
+      PyErr_SetString(PyExc_TypeError, "paths must be a list of bytes");
+      return nullptr;
+    }
+    paths[static_cast<size_t>(i)] = PyBytes_AS_STRING(item);
+  }
+
+  BufferGuard boxes_g, out_g;
+  if (!get_buffer(boxes_obj, boxes_g, PyBUF_C_CONTIGUOUS, "boxes"))
+    return nullptr;
+  if (!get_buffer(out_obj, out_g, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE, "out"))
+    return nullptr;
+  if (boxes_g.view.len < static_cast<Py_ssize_t>(n * 5 * sizeof(int32_t))) {
+    PyErr_SetString(PyExc_ValueError, "boxes buffer too small (need n*5 i32)");
+    return nullptr;
+  }
+  const size_t per_img = static_cast<size_t>(out_size) * out_size * 3;
+  if (out_g.view.len <
+      static_cast<Py_ssize_t>(n * per_img * sizeof(float))) {
+    PyErr_SetString(PyExc_ValueError, "out buffer too small");
+    return nullptr;
+  }
+  const int32_t* boxes = static_cast<const int32_t*>(boxes_g.view.buf);
+  float* out = static_cast<float*>(out_g.view.buf);
+
+  std::vector<uint8_t> failed(static_cast<size_t>(n), 0);
+  {
+    // the whole batch decodes without the GIL
+    Py_BEGIN_ALLOW_THREADS;
+    const int nthreads =
+        std::max(1, std::min<int>(threads, static_cast<int>(n)));
+    std::atomic<Py_ssize_t> next{0};
+    auto worker = [&]() {
+      for (;;) {
+        const Py_ssize_t i = next.fetch_add(1);
+        if (i >= n) break;
+        const int32_t* b = boxes + i * 5;
+        Task t{paths[static_cast<size_t>(i)], b[0], b[1], b[2], b[3],
+               static_cast<int>(b[4]), out_size, max_denom,
+               out + i * per_img};
+        if (!run_task(t, normalize != 0)) failed[static_cast<size_t>(i)] = 1;
+      }
+    };
+    if (nthreads == 1) {
+      worker();
+    } else {
+      std::vector<std::thread> pool;
+      pool.reserve(static_cast<size_t>(nthreads));
+      for (int k = 0; k < nthreads; ++k) pool.emplace_back(worker);
+      for (auto& th : pool) th.join();
+    }
+    Py_END_ALLOW_THREADS;
+  }
+
+  PyObject* fails = PyList_New(0);
+  if (!fails) return nullptr;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    if (failed[static_cast<size_t>(i)]) {
+      PyObject* idx = PyLong_FromSsize_t(i);
+      if (!idx || PyList_Append(fails, idx) != 0) {
+        Py_XDECREF(idx);
+        Py_DECREF(fails);
+        return nullptr;
+      }
+      Py_DECREF(idx);
+    }
+  }
+  return fails;
+}
+
+// decode_one(path: bytes, box: (l, t, w, h, flip), out_size, normalize)
+//   -> bytes (float32 S*S*3) | None  — single-image probe, used by tests.
+PyObject* py_decode_one(PyObject*, PyObject* args) {
+  const char* path;
+  int l, t, w, h, flip, out_size, normalize, max_denom = 8;
+  if (!PyArg_ParseTuple(args, "y(iiiii)ip|i", &path, &l, &t, &w, &h, &flip,
+                        &out_size, &normalize, &max_denom)) {
+    return nullptr;
+  }
+  const size_t per_img = static_cast<size_t>(out_size) * out_size * 3;
+  std::vector<float> buf(per_img);
+  Task task{path, l, t, w, h, flip, out_size, max_denom, buf.data()};
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  ok = run_task(task, normalize != 0);
+  Py_END_ALLOW_THREADS;
+  if (!ok) Py_RETURN_NONE;
+  return PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(buf.data()),
+      static_cast<Py_ssize_t>(per_img * sizeof(float)));
+}
+
+PyMethodDef kMethods[] = {
+    {"decode_batch", py_decode_batch, METH_VARARGS,
+     "decode_batch(paths, boxes_i32_n5, out_f32, out_size, threads, "
+     "normalize, max_denom=8) -> list of failed indices"},
+    {"decode_one", py_decode_one, METH_VARARGS,
+     "decode_one(path, (l, t, w, h, flip), out_size, normalize, "
+     "max_denom=8) -> float32 bytes or None"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_nativeloader",
+    "libjpeg decode + Pillow-compatible resample + augment, multithreaded",
+    -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__nativeloader(void) {
+  return PyModule_Create(&kModule);
+}

@@ -12,6 +12,15 @@ torchvision dependency this image lacks — and yields world-stacked batches
 ``(world, batch, H, W, C)`` that the sharded train step consumes directly.
 Same iteration contract as :class:`~.pipeline.ShardedLoader` (``len``,
 ``set_epoch``, ``fast_forward``) so the Trainer can use either.
+
+By default the per-image decode runs through the native C++ pipeline
+(data/native.py: libjpeg decode + Pillow-compatible resample + normalize
+on a GIL-free std::thread pool — the counterpart of the reference's C++
+DataLoader worker machinery); ``backend="pil"`` forces the pure-Python
+path.  Both backends draw the same augmentation stream (crop boxes and
+flips) by construction; pixel values agree to ~1 uint8 LSB with
+``max_denom=1`` and may differ more (still a faithful antialiased
+downscale) under the default DCT-domain fast decode.
 """
 
 from __future__ import annotations
@@ -33,7 +42,8 @@ class StreamingImageFolder:
     def __init__(self, root: str, split: str, world_size: int,
                  batch_size: int, image_size: int = 224, train: bool = True,
                  num_workers: int = 8, prefetch: int = 4, seed: int = 0,
-                 ranks: tp.Sequence[int] | None = None):
+                 ranks: tp.Sequence[int] | None = None,
+                 backend: str = "auto", max_denom: int = 8):
         self.dataset = ImageFolderDataset(
             f"{root}/{split}" if split else root,
             image_size=image_size, train=train, seed=seed)
@@ -45,6 +55,35 @@ class StreamingImageFolder:
         # multi-host: decode only this process's rank rows
         self.ranks = None if ranks is None else list(ranks)
         self.start_itr = 0
+        # backend: "native" = the C++ pipeline (data/native.py; libjpeg
+        # decode + resample + normalize on a GIL-free std::thread pool),
+        # "pil" = pure Python, "auto" = native when it builds.  The native
+        # decoder replays the dataset's exact per-(seed, epoch, index)
+        # augmentation rng (same crops/flips); pixel values match PIL to
+        # ~1 uint8 LSB at max_denom=1, while the default max_denom=8
+        # allows DCT-domain downscaled decodes on large images — visually
+        # equivalent but not LSB-close (tested bound: within a few LSB on
+        # average).  Pass max_denom=1 for strict parity.
+        if backend not in ("auto", "native", "pil"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.decoder = None
+        if backend != "pil":
+            from .native import NativeDecoder
+            dec = NativeDecoder(self.dataset.paths, image_size, train,
+                                seed=seed, threads=self.num_workers,
+                                max_denom=max_denom)
+            if dec.available:
+                self.decoder = dec
+            elif backend == "native":
+                import os as _os
+                hint = ""
+                if _os.environ.get("SGP_NATIVE_LOADER", "1").lower() in (
+                        "0", "off", "false"):
+                    hint = (" (SGP_NATIVE_LOADER="
+                            f"{_os.environ['SGP_NATIVE_LOADER']!r} disables "
+                            "it — unset the env var)")
+                raise RuntimeError("backend='native' but the native loader "
+                                   f"is unavailable{hint}")
 
     @property
     def classes(self) -> list[str]:
@@ -56,6 +95,8 @@ class StreamingImageFolder:
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
         self.dataset.set_epoch(epoch)
+        if self.decoder is not None:
+            self.decoder.set_epoch(epoch)
 
     def fast_forward(self, itr: int) -> None:
         self.start_itr = int(itr)
@@ -64,7 +105,10 @@ class StreamingImageFolder:
                     ) -> tuple[np.ndarray, np.ndarray]:
         """Decode one batch block: idx_block is (rows, batch) indices."""
         flat = idx_block.reshape(-1)
-        images = np.stack([self.dataset[i][0] for i in flat])
+        if self.decoder is not None:
+            images = self.decoder.decode(flat)
+        else:
+            images = np.stack([self.dataset[i][0] for i in flat])
         labels = np.asarray([self.dataset.labels[i] for i in flat],
                             np.int32)
         s = self.dataset.image_size
@@ -83,8 +127,13 @@ class StreamingImageFolder:
                   for b in range(start, n_batches)]
         if not blocks:
             return
+        # native decode parallelizes WITHIN a batch (C++ pool of
+        # num_workers threads), so the outer executor only needs enough
+        # workers to overlap produce with consume; the PIL path gets all
+        # its parallelism from the outer pool instead.
+        outer = 2 if self.decoder is not None else self.num_workers
         with concurrent.futures.ThreadPoolExecutor(
-                max_workers=self.num_workers) as pool:
+                max_workers=outer) as pool:
             window: list = []
             block_iter = iter(blocks)
             for blk in block_iter:

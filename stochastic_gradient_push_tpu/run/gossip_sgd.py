@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_dataloader_workers", default=8, type=int,
                    help="decode worker threads for the imagefolder "
                         "streaming loader (synthetic data ignores this)")
+    p.add_argument("--data_backend", default="auto",
+                   choices=["auto", "native", "pil"],
+                   help="imagefolder decode path: the native C++ pipeline "
+                        "(libjpeg + GIL-free thread pool), pure-PIL, or "
+                        "auto (native when it builds)")
     p.add_argument("--num_epochs", default=90, type=int)
     p.add_argument("--num_iterations_per_training_epoch", default=None,
                    type=int, help="early exit for testing")
@@ -311,12 +316,13 @@ def main(argv=None, config_transform=None, extra_args=None):
         loader = StreamingImageFolder(
             args.dataset_dir, "train", world, cfg.batch_size,
             image_size=args.image_size, train=True,
-            num_workers=workers, seed=cfg.seed, ranks=local_ranks)
+            num_workers=workers, seed=cfg.seed, ranks=local_ranks,
+            backend=args.data_backend)
         sampler = loader  # owns set_epoch for both sampling and augment
         val_loader = StreamingImageFolder(
             args.dataset_dir, "val", world, cfg.batch_size,
             image_size=args.image_size, train=False, num_workers=workers,
-            ranks=local_ranks)
+            ranks=local_ranks, backend=args.data_backend)
 
     if args.dataset == "synthetic":
         val_sampler = DistributedSampler(len(val_images), world)
