@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-4 follow-up chip work (after the main tpu_window.sh capture):
+#   1. asymmetric flash block sweep  -> decides the auto-block rule
+#   2. ResNet batch sweep 192/256    -> does a bigger batch move MFU?
+# Probes the tunnel every ~4 min and fires the moment it answers.
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+OUT="$REPO/docs/tpu_runs/$(date -u +%Y%m%dT%H%M%S)_followup"
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-9}*3600 ))
+N=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  N=$((N+1))
+  KIND=$(timeout 75 python -c "import jax; d=jax.devices(); print(d[0].device_kind, len(d))" 2>/dev/null)
+  case "$KIND" in
+    *[Cc]pu*|"") echo "[$(date -u +%H:%M:%S)] probe $N: tunnel down ('$KIND')";;
+    *) echo "[$(date -u +%H:%M:%S)] probe $N: ALIVE: $KIND"
+       mkdir -p "$OUT"
+       echo "== flash block sweep =="
+       timeout 1200 python examples/bench_flash_blocks.py \
+         > "$OUT/flashblocks.txt" 2>"$OUT/flashblocks.err"
+       tail -4 "$OUT/flashblocks.txt"
+       echo "== batch sweep =="
+       for BB in 192 256; do
+         BENCH_BATCH=$BB BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=0 \
+           timeout 600 python "$REPO/bench.py" 2>>"$OUT/batchsweep.err" \
+           | tail -1 | tee -a "$OUT/batchsweep.jsonl"
+       done
+       echo "== done: $OUT =="
+       exit 0 ;;
+  esac
+  sleep 240
+done
+echo "deadline reached"
+exit 1

@@ -54,25 +54,15 @@ timeout 900 python examples/bench_lm_tpu.py \
   > "$OUT/lm.txt" 2>"$OUT/lm.err"
 tail -6 "$OUT/lm.txt"
 
-echo "== 4/4 profiler trace of the ResNet step (MFU decomposition) =="
-export TRACE_DIR="$OUT/trace"
-timeout 600 python - > "$OUT/profile.txt" 2>&1 <<'PYEOF'
-# Capture a device trace of a few warmed ResNet-50 SGP steps; the
-# .xplane artifact under docs/tpu_runs/<ts>/trace supports the
-# backward/optimizer attribution BENCH's fwd/fwdbwd probes bracket.
-import os
-os.environ.setdefault("BENCH_BATCH", "128")
-os.environ["BENCH_SCAN"] = "1"
-os.environ["BENCH_STEPS"] = "3"
-os.environ["BENCH_WARMUP"] = "3"
-os.environ["BENCH_AR"] = "0"
-os.environ["BENCH_PHASES"] = "0"
-import jax, bench
-with jax.profiler.trace(os.environ["TRACE_DIR"]):
-    r = bench.run_measurement()
-print(r)
-PYEOF
-tail -4 "$OUT/profile.txt"
+echo "== 4/4 ResNet batch sweep (192/256: does bigger batch move MFU?) =="
+# NOTE: jax.profiler.trace HANGS over the axon tunnel (round-4 capture:
+# step 4 consumed its whole 600 s timeout and wrote nothing), so the MFU
+# decomposition rides bench.py's fwd/fwdbwd probes instead of a trace.
+for BB in 192 256; do
+  BENCH_BATCH=$BB BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=0 \
+    timeout 600 python bench.py 2>>"$OUT/batchsweep.err" \
+    | tail -1 | tee -a "$OUT/batchsweep.jsonl"
+done
 
 echo "== done: $OUT =="
 ls -la "$OUT"

@@ -57,7 +57,9 @@ class TransformerConfig(tp.NamedTuple):
     max_len: int = 2048
     dtype: tp.Any = jnp.float32
     attn_impl: str = "full"     # full | blockwise | flash | ring | ring_flash
-    attn_block_size: int = 128        # for blockwise
+    # block size for blockwise/flash/ring_flash; None = the measured
+    # auto rule (ops.flash_attention.default_block) on the local length
+    attn_block_size: int | None = None
     seq_axis: str | None = None       # mesh axis for ring attention
     remat: bool = False               # jax.checkpoint each block
     moe_experts: int = 0              # total experts (0 = dense FFN)
@@ -98,17 +100,20 @@ class _Attention(nn.Module):
             # path (ops/ring_flash.py)
             if cfg.seq_axis is None:
                 raise ValueError("ring attention requires seq_axis")
+            from ..ops.flash_attention import default_block
             from ..ops.ring_flash import ring_flash_attention
-            out = ring_flash_attention(q, k, v, cfg.seq_axis, causal=True,
-                                       block=cfg.attn_block_size)
+            out = ring_flash_attention(
+                q, k, v, cfg.seq_axis, causal=True,
+                block=cfg.attn_block_size or default_block(q.shape[2]))
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,
                                   block_q=cfg.attn_block_size,
                                   block_k=cfg.attn_block_size)
         elif cfg.attn_impl == "blockwise":
-            out = blockwise_attention(q, k, v, cfg.attn_block_size,
-                                      causal=True)
+            out = blockwise_attention(
+                q, k, v, min(cfg.attn_block_size or 128, q.shape[2]),
+                causal=True)
         elif cfg.attn_impl == "full":
             t = q.shape[2]
             mask = jnp.tril(jnp.ones((t, t), bool))[None, None]

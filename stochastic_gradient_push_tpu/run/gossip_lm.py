@@ -282,15 +282,20 @@ def main(argv=None):
         Mosaic layouts are shape/dtype-specific, so a fixed probe shape
         could pass while the real model still fails."""
         try:
-            from ..ops.flash_attention import flash_attention_forward
+            from ..ops.flash_attention import (default_block,
+                                               flash_attention_forward)
 
             dtype = (jnp.bfloat16 if args.precision == "bf16"
                      else jnp.float32)
             head_dim = args.d_model // args.n_heads
-            t = min(128, args.seq_len)
+            # the run's auto-selected block (Mosaic layouts are
+            # block-shape-specific, so probe the block the run will use)
+            blk = default_block(args.seq_len)
+            t = min(args.seq_len, 2 * blk)
             x = jnp.zeros((1, 1, t, head_dim), dtype)
             jax.block_until_ready(
-                flash_attention_forward(x, x, x, causal=True))
+                flash_attention_forward(x, x, x, causal=True,
+                                        block_q=blk, block_k=blk))
             return True
         except Exception as e:  # Mosaic/XLA compile or runtime rejection
             log.warning(
