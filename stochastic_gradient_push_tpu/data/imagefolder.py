@@ -81,8 +81,11 @@ def _random_resized_crop_box(w: int, h: int, rng: np.random.Generator,
 
 
 def load_image(path: str, image_size: int, train: bool,
-               rng: np.random.Generator | None = None) -> np.ndarray:
-    """Decode + transform one image → float32 NHW C (normalized)."""
+               rng: np.random.Generator | None = None,
+               raw: bool = False) -> np.ndarray:
+    """Decode + transform one image → float32 HWC (normalized), or the
+    pre-normalization uint8 pixels when ``raw`` (the device-side-normalize
+    pipeline; see train/step.py)."""
     from PIL import Image
 
     with Image.open(path) as img:
@@ -106,6 +109,8 @@ def load_image(path: str, image_size: int, train: bool,
             top = (nh - image_size) // 2
             img = img.crop((left, top, left + image_size,
                             top + image_size))
+        if raw:
+            return np.asarray(img, np.uint8)
         arr = np.asarray(img, np.float32) / 255.0
     return (arr - IMAGENET_MEAN) / IMAGENET_STD
 

@@ -170,21 +170,30 @@ class NativeDecoder:
             boxes[j] = (l, t, cw, ch, 1 if rng.random() < 0.5 else 0)
         return boxes
 
-    def decode(self, indices: np.ndarray,
-               out: np.ndarray | None = None) -> np.ndarray:
-        """Decode ``indices`` -> float32 (n, S, S, 3), normalized."""
+    def decode(self, indices: np.ndarray, out: np.ndarray | None = None,
+               output: str = "f32") -> np.ndarray:
+        """Decode ``indices`` -> (n, S, S, 3).
+
+        ``output="f32"`` yields ImageNet-normalized float32 (the classic
+        contract); ``"uint8"`` yields raw pixels — 4x smaller to ship to
+        the device, where the train step normalizes (train/step.py).
+        """
+        if output not in ("f32", "uint8"):
+            raise ValueError(f"unknown output {output!r}")
+        dtype = np.float32 if output == "f32" else np.uint8
+        mode = 0 if output == "f32" else 2
         indices = np.asarray(indices).reshape(-1)
         n, S = len(indices), self.image_size
         if out is None:
-            out = np.empty((n, S, S, 3), np.float32)
-        assert out.shape == (n, S, S, 3) and out.dtype == np.float32
+            out = np.empty((n, S, S, 3), dtype)
+        assert out.shape == (n, S, S, 3) and out.dtype == dtype
         if self._native is None:
             self._pil_many(indices, range(len(indices)), out)
             return out
         boxes = self.sample_boxes(indices)
         paths = [os.fsencode(self.paths[int(i)]) for i in indices]
         failed = self._native.decode_batch(paths, boxes, out, S,
-                                           self.threads, True,
+                                           self.threads, mode,
                                            self.max_denom)
         # anything libjpeg rejected (PNG/webp/CMYK/truncated) decodes via
         # PIL — threaded, so a mostly-non-JPEG dataset keeps its decode
@@ -197,16 +206,18 @@ class NativeDecoder:
         slots = list(slots)
         if len(slots) <= 1 or self.threads == 1:
             for j in slots:
-                out[j] = self._pil_one(int(indices[j]))
+                out[j] = self._pil_one(int(indices[j]), out.dtype)
             return
         import concurrent.futures
 
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(self.threads, len(slots))) as pool:
             for j, img in zip(slots, pool.map(
-                    lambda j: self._pil_one(int(indices[j])), slots)):
+                    lambda j: self._pil_one(int(indices[j]), out.dtype),
+                    slots)):
                 out[j] = img
 
-    def _pil_one(self, idx: int) -> np.ndarray:
+    def _pil_one(self, idx: int, dtype=np.float32) -> np.ndarray:
         return load_image(self.paths[idx], self.image_size, self.train,
-                          self._rng(idx) if self.train else None)
+                          self._rng(idx) if self.train else None,
+                          raw=(dtype == np.uint8))

@@ -11,7 +11,9 @@
 //
 //   JPEG decode (libjpeg)  ->  crop  ->  separable triangle-filter
 //   resample (Pillow-compatible BILINEAR, antialiased on downscale)
-//   ->  horizontal flip  ->  float32 normalize (ImageNet mean/std)
+//   ->  horizontal flip  ->  output as float32 ImageNet-normalized,
+//   float32 raw [0,1], or uint8 (4x smaller host->device transfer;
+//   the train step normalizes uint8 inputs on device)
 //
 // Both transform orders of data/imagefolder.py are reproduced exactly:
 //   train:  crop(box) -> resize(S,S) -> optional flip      (load_image)
@@ -182,14 +184,20 @@ FilterTable triangle_coeffs(int in_size, int out_size, double box_start,
   return ft;
 }
 
+// Output modes: float32 ImageNet-normalized (the classic contract),
+// float32 raw [0,1], or uint8 — the latter shrinks the host->device
+// transfer 4x and lets the compiled train step fuse the normalize into
+// the stem convolution (train/step.py normalizes uint8 inputs on device).
+enum class OutMode : int { kF32Norm = 0, kF32Raw = 1, kU8 = 2 };
+
 // Finalization applied as each output row completes: clamp to [0, 255],
 // round to the uint8 grid PIL materializes, optional horizontal flip,
-// optional ImageNet normalize, write float32.
+// then write in the requested output mode.
 struct Finalize {
   bool flip = false;
-  bool normalize = true;
-  int out_w = 0;      // row width of dst
-  float* dst = nullptr;
+  OutMode mode = OutMode::kF32Norm;
+  int out_w = 0;       // row width of dst
+  void* dst = nullptr;  // float* or uint8_t* per mode
 };
 
 constexpr float kMean[3] = {0.485f, 0.456f, 0.406f};
@@ -250,16 +258,26 @@ void resample_window(const uint8_t* src, int w, int h, double box_l,
           tmp.data() + static_cast<size_t>(ymin - row_lo + y) * ww * 3;
       for (int x = 0; x < ww * 3; ++x) acc[static_cast<size_t>(x)] += c * trow[x];
     }
-    float* drow = fin.dst + static_cast<size_t>(yy - y0) * fin.out_w * 3;
+    const size_t row_off = static_cast<size_t>(yy - y0) * fin.out_w * 3;
+    float* frow = static_cast<float*>(fin.dst) + row_off;
+    uint8_t* urow = static_cast<uint8_t*>(fin.dst) + row_off;
     for (int x = 0; x < ww; ++x) {
       const int sx = fin.flip ? (ww - 1 - x) : x;
       for (int c = 0; c < 3; ++c) {
         float v = acc[static_cast<size_t>(sx) * 3 + c];
         v = std::min(std::max(v, 0.0f), 255.0f);
         v = std::nearbyintf(v);  // PIL's uint8 quantization
-        drow[3 * x + c] = fin.normalize
-                              ? (v / 255.0f - kMean[c]) / kStd[c]
-                              : v / 255.0f;
+        switch (fin.mode) {
+          case OutMode::kF32Norm:
+            frow[3 * x + c] = (v / 255.0f - kMean[c]) / kStd[c];
+            break;
+          case OutMode::kF32Raw:
+            frow[3 * x + c] = v / 255.0f;
+            break;
+          case OutMode::kU8:
+            urow[3 * x + c] = static_cast<uint8_t>(v);
+            break;
+        }
       }
     }
   }
@@ -294,10 +312,10 @@ struct Task {
   int flip;       // train only
   int out_size;   // S
   int max_denom;  // cap on the DCT-domain downscale (1 disables)
-  float* dst;     // S*S*3 float32, normalized
+  void* dst;      // S*S*3, float32 or uint8 per mode
 };
 
-bool run_task(const Task& t, bool normalize) {
+bool run_task(const Task& t, OutMode mode) {
   std::vector<uint8_t> raw;
   if (!read_file(t.path, raw)) return false;
   // JPEG magic; everything else goes to the Python fallback.
@@ -328,7 +346,7 @@ bool run_task(const Task& t, bool normalize) {
 
   const int S = t.out_size;
   Finalize fin;
-  fin.normalize = normalize;
+  fin.mode = mode;
   fin.out_w = S;
   fin.dst = t.dst;
   if (train) {
@@ -394,17 +412,23 @@ bool get_buffer(PyObject* obj, BufferGuard& g, int flags, const char* name) {
 
 // decode_batch(paths: list[bytes], boxes: int32 buffer (n, 5) =
 //   (box_l, box_t, box_w, box_h, flip) with box_w < 0 => eval,
-//   out: float32 buffer (n * S * S * 3), out_size: int, threads: int,
-//   normalize: bool) -> list[int]   (indices that need the PIL fallback)
+//   out: buffer (n * S * S * 3; float32 for modes 0/1, uint8 for 2),
+//   out_size: int, threads: int, mode: int {0: f32 normalized,
+//   1: f32 raw, 2: uint8}) -> list[int] (indices for the PIL fallback)
 PyObject* py_decode_batch(PyObject*, PyObject* args) {
   PyObject* paths_obj;
   PyObject* boxes_obj;
   PyObject* out_obj;
-  int out_size, threads, normalize, max_denom = 8;
-  if (!PyArg_ParseTuple(args, "OOOiip|i", &paths_obj, &boxes_obj, &out_obj,
-                        &out_size, &threads, &normalize, &max_denom)) {
+  int out_size, threads, mode_i, max_denom = 8;
+  if (!PyArg_ParseTuple(args, "OOOiii|i", &paths_obj, &boxes_obj, &out_obj,
+                        &out_size, &threads, &mode_i, &max_denom)) {
     return nullptr;
   }
+  if (mode_i < 0 || mode_i > 2) {
+    PyErr_SetString(PyExc_ValueError, "mode must be 0, 1 or 2");
+    return nullptr;
+  }
+  const OutMode mode = static_cast<OutMode>(mode_i);
   if (!PyList_Check(paths_obj)) {
     PyErr_SetString(PyExc_TypeError, "paths must be a list of bytes");
     return nullptr;
@@ -432,13 +456,13 @@ PyObject* py_decode_batch(PyObject*, PyObject* args) {
     return nullptr;
   }
   const size_t per_img = static_cast<size_t>(out_size) * out_size * 3;
-  if (out_g.view.len <
-      static_cast<Py_ssize_t>(n * per_img * sizeof(float))) {
+  const size_t elem = mode == OutMode::kU8 ? 1 : sizeof(float);
+  if (out_g.view.len < static_cast<Py_ssize_t>(n * per_img * elem)) {
     PyErr_SetString(PyExc_ValueError, "out buffer too small");
     return nullptr;
   }
   const int32_t* boxes = static_cast<const int32_t*>(boxes_g.view.buf);
-  float* out = static_cast<float*>(out_g.view.buf);
+  uint8_t* out = static_cast<uint8_t*>(out_g.view.buf);
 
   std::vector<uint8_t> failed(static_cast<size_t>(n), 0);
   {
@@ -454,8 +478,8 @@ PyObject* py_decode_batch(PyObject*, PyObject* args) {
         const int32_t* b = boxes + i * 5;
         Task t{paths[static_cast<size_t>(i)], b[0], b[1], b[2], b[3],
                static_cast<int>(b[4]), out_size, max_denom,
-               out + i * per_img};
-        if (!run_task(t, normalize != 0)) failed[static_cast<size_t>(i)] = 1;
+               out + i * per_img * elem};
+        if (!run_task(t, mode)) failed[static_cast<size_t>(i)] = 1;
       }
     };
     if (nthreads == 1) {
@@ -485,35 +509,42 @@ PyObject* py_decode_batch(PyObject*, PyObject* args) {
   return fails;
 }
 
-// decode_one(path: bytes, box: (l, t, w, h, flip), out_size, normalize)
-//   -> bytes (float32 S*S*3) | None  — single-image probe, used by tests.
+// decode_one(path: bytes, box: (l, t, w, h, flip), out_size, mode)
+//   -> bytes (S*S*3 of float32 or uint8 per mode) | None — single-image
+//   probe, used by tests.
 PyObject* py_decode_one(PyObject*, PyObject* args) {
   const char* path;
-  int l, t, w, h, flip, out_size, normalize, max_denom = 8;
-  if (!PyArg_ParseTuple(args, "y(iiiii)ip|i", &path, &l, &t, &w, &h, &flip,
-                        &out_size, &normalize, &max_denom)) {
+  int l, t, w, h, flip, out_size, mode_i, max_denom = 8;
+  if (!PyArg_ParseTuple(args, "y(iiiii)ii|i", &path, &l, &t, &w, &h, &flip,
+                        &out_size, &mode_i, &max_denom)) {
     return nullptr;
   }
+  if (mode_i < 0 || mode_i > 2) {
+    PyErr_SetString(PyExc_ValueError, "mode must be 0, 1 or 2");
+    return nullptr;
+  }
+  const OutMode mode = static_cast<OutMode>(mode_i);
   const size_t per_img = static_cast<size_t>(out_size) * out_size * 3;
-  std::vector<float> buf(per_img);
+  const size_t elem = mode == OutMode::kU8 ? 1 : sizeof(float);
+  std::vector<uint8_t> buf(per_img * elem);
   Task task{path, l, t, w, h, flip, out_size, max_denom, buf.data()};
   bool ok;
   Py_BEGIN_ALLOW_THREADS;
-  ok = run_task(task, normalize != 0);
+  ok = run_task(task, mode);
   Py_END_ALLOW_THREADS;
   if (!ok) Py_RETURN_NONE;
   return PyBytes_FromStringAndSize(
       reinterpret_cast<const char*>(buf.data()),
-      static_cast<Py_ssize_t>(per_img * sizeof(float)));
+      static_cast<Py_ssize_t>(buf.size()));
 }
 
 PyMethodDef kMethods[] = {
     {"decode_batch", py_decode_batch, METH_VARARGS,
-     "decode_batch(paths, boxes_i32_n5, out_f32, out_size, threads, "
-     "normalize, max_denom=8) -> list of failed indices"},
+     "decode_batch(paths, boxes_i32_n5, out, out_size, threads, "
+     "mode{0:f32norm,1:f32raw,2:u8}, max_denom=8) -> failed indices"},
     {"decode_one", py_decode_one, METH_VARARGS,
-     "decode_one(path, (l, t, w, h, flip), out_size, normalize, "
-     "max_denom=8) -> float32 bytes or None"},
+     "decode_one(path, (l, t, w, h, flip), out_size, "
+     "mode{0:f32norm,1:f32raw,2:u8}, max_denom=8) -> bytes or None"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -43,7 +43,8 @@ class StreamingImageFolder:
                  batch_size: int, image_size: int = 224, train: bool = True,
                  num_workers: int = 8, prefetch: int = 4, seed: int = 0,
                  ranks: tp.Sequence[int] | None = None,
-                 backend: str = "auto", max_denom: int = 8):
+                 backend: str = "auto", max_denom: int = 8,
+                 output: str = "f32"):
         self.dataset = ImageFolderDataset(
             f"{root}/{split}" if split else root,
             image_size=image_size, train=train, seed=seed)
@@ -66,6 +67,12 @@ class StreamingImageFolder:
         # average).  Pass max_denom=1 for strict parity.
         if backend not in ("auto", "native", "pil"):
             raise ValueError(f"unknown backend {backend!r}")
+        # output: "f32" = ImageNet-normalized float32; "uint8" = raw
+        # pixels, 4x smaller host->device, normalized ON DEVICE by the
+        # train/eval steps (dtype-triggered; train/step.py)
+        if output not in ("f32", "uint8"):
+            raise ValueError(f"unknown output {output!r}")
+        self.output = output
         self.decoder = None
         if backend != "pil":
             from .native import NativeDecoder
@@ -106,7 +113,15 @@ class StreamingImageFolder:
         """Decode one batch block: idx_block is (rows, batch) indices."""
         flat = idx_block.reshape(-1)
         if self.decoder is not None:
-            images = self.decoder.decode(flat)
+            images = self.decoder.decode(flat, output=self.output)
+        elif self.output == "uint8":
+            from .imagefolder import augmentation_rng, load_image
+            ds = self.dataset
+            images = np.stack([
+                load_image(ds.paths[i], ds.image_size, ds.train,
+                           augmentation_rng(ds.seed, ds.epoch, i)
+                           if ds.train else None, raw=True)
+                for i in flat])
         else:
             images = np.stack([self.dataset[i][0] for i in flat])
         labels = np.asarray([self.dataset.labels[i] for i in flat],

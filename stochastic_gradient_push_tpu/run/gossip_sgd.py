@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="imagefolder decode path: the native C++ pipeline "
                         "(libjpeg + GIL-free thread pool), pure-PIL, or "
                         "auto (native when it builds)")
+    p.add_argument("--data_output", default="f32",
+                   choices=["f32", "uint8"],
+                   help="loader output: host-normalized float32, or raw "
+                        "uint8 pixels normalized on device (4x smaller "
+                        "host-to-device transfer)")
     p.add_argument("--num_epochs", default=90, type=int)
     p.add_argument("--num_iterations_per_training_epoch", default=None,
                    type=int, help="early exit for testing")
@@ -317,12 +322,13 @@ def main(argv=None, config_transform=None, extra_args=None):
             args.dataset_dir, "train", world, cfg.batch_size,
             image_size=args.image_size, train=True,
             num_workers=workers, seed=cfg.seed, ranks=local_ranks,
-            backend=args.data_backend)
+            backend=args.data_backend, output=args.data_output)
         sampler = loader  # owns set_epoch for both sampling and augment
         val_loader = StreamingImageFolder(
             args.dataset_dir, "val", world, cfg.batch_size,
             image_size=args.image_size, train=False, num_workers=workers,
-            ranks=local_ranks, backend=args.data_backend)
+            ranks=local_ranks, backend=args.data_backend,
+            output=args.data_output)
 
     if args.dataset == "synthetic":
         val_sampler = DistributedSampler(len(val_images), world)

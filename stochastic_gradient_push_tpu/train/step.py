@@ -39,6 +39,20 @@ __all__ = ["build_train_step", "build_eval_step", "shard_train_step",
            "replicate_state", "unreplicate", "replica_spread"]
 
 
+def _device_normalize(images):
+    """uint8 batches normalize ON DEVICE (fused by XLA into the stem
+    conv): the loader ships raw pixels — a 4x smaller host->device
+    transfer than float32 (data/streaming.py ``output="uint8"``).
+    float batches pass through, already normalized on host."""
+    if images.dtype != jnp.uint8:
+        return images
+    from ..data.imagefolder import IMAGENET_MEAN, IMAGENET_STD
+
+    mean = jnp.asarray(IMAGENET_MEAN, jnp.float32)
+    std = jnp.asarray(IMAGENET_STD, jnp.float32)
+    return (images.astype(jnp.float32) / 255.0 - mean) / std
+
+
 def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                      itr_per_epoch: int, num_classes: int,
                      local_axis: str | None = None,
@@ -71,6 +85,7 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         raise ValueError("grad_accum must be >= 1")
 
     def train_step(state: TrainState, images, labels):
+        images = _device_normalize(images)
         params, gstate = algorithm.pre_step(state.params, state.gossip)
         z = algorithm.eval_params(params, gstate)
 
@@ -164,6 +179,7 @@ def build_eval_step(model, algorithm: GossipAlgorithm,
     independently, no collectives)."""
 
     def eval_step(state: TrainState, images, labels):
+        images = _device_normalize(images)
         z = algorithm.eval_params(state.params, state.gossip)
         logits = model.apply(
             {"params": z, "batch_stats": state.batch_stats},

@@ -163,6 +163,59 @@ def test_streaming_backend_native_matches_pil(image_root, train):
         assert float(np.abs(xi - xp).max()) < 2.5 * LSB
 
 
+def test_uint8_output_matches_f32_after_normalize(image_root):
+    """output='uint8' must carry the SAME pixels as the f32 path pre-
+    normalization: normalizing the uint8 batch reproduces the f32 batch
+    bit-exactly (both quantize to the uint8 grid before normalize)."""
+    from stochastic_gradient_push_tpu.data.imagefolder import (
+        IMAGENET_MEAN, IMAGENET_STD)
+
+    ds, dec = _decoders(image_root, train=True, image_size=64)
+    idx = np.arange(len(ds))
+    u8 = dec.decode(idx, output="uint8")
+    f32 = dec.decode(idx, output="f32")
+    assert u8.dtype == np.uint8
+    renorm = (u8.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(renorm, f32, atol=1e-6)
+
+
+def test_uint8_streaming_and_device_normalize(image_root):
+    """End to end: a uint8-streamed batch through the jitted train step
+    equals the f32-streamed batch (device normalize == host normalize)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stochastic_gradient_push_tpu.train.step import _device_normalize
+
+    kw = dict(split="", world_size=2, batch_size=2, image_size=96,
+              train=True, num_workers=2, prefetch=2, seed=1)
+    u8 = StreamingImageFolder(image_root, output="uint8", **kw)
+    f32 = StreamingImageFolder(image_root, output="f32", **kw)
+    (xu, yu), (xf, yf) = next(iter(u8)), next(iter(f32))
+    assert xu.dtype == np.uint8 and xf.dtype == np.float32
+    np.testing.assert_array_equal(yu, yf)
+    normed = jax.jit(_device_normalize)(jnp.asarray(xu))
+    np.testing.assert_allclose(np.asarray(normed), xf, atol=1e-6)
+    # float batches pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(_device_normalize)(jnp.asarray(xf))), xf)
+
+
+def test_uint8_pil_backend(image_root):
+    """The uint8 contract holds on the pure-PIL backend too (fallback
+    parity: PNGs and toolchain-less hosts)."""
+    kw = dict(split="", world_size=2, batch_size=2, image_size=96,
+              train=False, num_workers=2, prefetch=2, seed=1)
+    nat = StreamingImageFolder(image_root, backend="native",
+                               output="uint8", **kw)
+    pil = StreamingImageFolder(image_root, backend="pil",
+                               output="uint8", **kw)
+    for (xi, yi), (xp, yp) in zip(nat, pil):
+        assert xi.dtype == xp.dtype == np.uint8
+        np.testing.assert_array_equal(yi, yp)
+        assert int(np.abs(xi.astype(int) - xp.astype(int)).max()) <= 2
+
+
 def test_bad_file_falls_back(image_root, tmp_path):
     d = tmp_path / "bad" / "c"
     d.mkdir(parents=True)
