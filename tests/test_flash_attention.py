@@ -35,6 +35,49 @@ def test_flash_kernel_matches_blockwise(qkv, causal, block):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_kernel_wide_head_dim():
+    """head_dim 128 (v5e lane width) through forward AND backward: the
+    production LM shapes use d=64; this pins the d=128 layouts the
+    kernels' scratch/accumulators must also support."""
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 2, 64, 128)), jnp.float32)
+               for _ in range(3))
+    got = flash_attention_forward(q, k, v, causal=True, block_q=32,
+                                  block_k=32, interpret=True)
+    want = blockwise_attention(q, k, v, 32, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    from stochastic_gradient_push_tpu.ops.flash_attention import (
+        flash_attention_backward)
+
+    out, lse = flash_attention_forward(q, k, v, causal=True, block_q=32,
+                                       block_k=32, interpret=True,
+                                       return_lse=True)
+    do = jnp.asarray(rng.normal(size=out.shape), jnp.float32)
+    dq, dk, dv = flash_attention_backward(q, k, v, out, lse, do,
+                                          causal=True, block_q=32,
+                                          block_k=32, interpret=True)
+
+    def loss(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, 32, causal=True) * do)
+
+    wq, wk, wv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in ((dq, wq), (dk, wk), (dv, wv)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_default_block_rule():
+    from stochastic_gradient_push_tpu.ops.flash_attention import (
+        default_block)
+
+    assert default_block(64) == 64
+    assert default_block(1024) == 128
+    assert default_block(2048) == 512
+    assert default_block(4096) == 512
+    assert default_block(2048 + 128) == 128  # not divisible by 512
+
+
 @pytest.mark.parametrize("block_q,block_k", [(16, 32), (32, 16)])
 def test_flash_kernel_mixed_block_sizes(qkv, block_q, block_k):
     """Both aspect ratios exercise the causal index-map clamps (a
