@@ -1,0 +1,89 @@
+"""Host data-loader throughput: native C++ pipeline vs pure PIL.
+
+Generates an ImageNet-shaped synthetic JPEG folder (once, cached in
+/tmp), then times train-mode decode+augment batches through both
+backends and both output modes.  The native loader's edge per core comes
+from the single-session libjpeg decode, windowed resampling, and the
+DCT-domain fast path; its edge across cores comes from the GIL-free
+std::thread pool (invisible on a 1-core host — recorded for context).
+
+Usage: python examples/bench_loader.py        (no TPU needed)
+Env: LOADERBENCH_N (images, default 96), LOADERBENCH_SIZE (output, 224).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from stochastic_gradient_push_tpu.data.native import NativeDecoder, get_native
+
+N = int(os.environ.get("LOADERBENCH_N", "96"))
+SIZE = int(os.environ.get("LOADERBENCH_SIZE", "224"))
+ROOT = f"/tmp/sgp_loaderbench_{N}"
+
+
+def make_dataset():
+    from PIL import Image
+
+    d = os.path.join(ROOT, "c0")
+    if os.path.isdir(d) and len(os.listdir(d)) >= N:
+        return sorted(os.path.join(d, f) for f in os.listdir(d))[:N]
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(N):
+        # ImageNet-ish dims ~500x375, smoothed noise so JPEG size is
+        # realistic
+        w, h = int(rng.integers(400, 600)), int(rng.integers(300, 450))
+        arr = (rng.random((h // 4, w // 4, 3)) * 255).astype(np.uint8)
+        img = Image.fromarray(arr).resize((w, h), Image.BILINEAR)
+        p = os.path.join(d, f"img{i:04d}.jpg")
+        img.save(p, quality=90)
+        paths.append(p)
+    return paths
+
+
+def timed(fn, reps=3):
+    fn()  # warm (dims cache, native build)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    paths = make_dataset()
+    idx = np.arange(len(paths))
+    threads = min(16, os.cpu_count() or 1)
+    rows = []
+    for backend in ("native", "pil"):
+        if backend == "native" and get_native() is None:
+            rows.append({"backend": "native",
+                         "error": "unavailable (g++/libjpeg)"})
+            continue
+        for output in ("f32", "uint8"):
+            dec = NativeDecoder(paths, SIZE, train=True, seed=0,
+                                threads=threads)
+            if backend == "pil":
+                dec._native = None  # force the pure-PIL path
+            dt = timed(lambda: dec.decode(idx, output=output))
+            rows.append({"backend": backend, "output": output,
+                         "threads": threads if backend == "native" else 1,
+                         "img_per_sec": round(len(idx) / dt, 1)})
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    nat = next((r for r in rows if r.get("backend") == "native"
+                and r.get("output") == "f32"), None)
+    pil = next((r for r in rows if r.get("backend") == "pil"
+                and r.get("output") == "f32"), None)
+    if nat and pil and "img_per_sec" in nat and "img_per_sec" in pil:
+        print(json.dumps({
+            "metric": "native_vs_pil_speedup",
+            "value": round(nat["img_per_sec"] / pil["img_per_sec"], 2),
+            "cores": os.cpu_count()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
