@@ -16,6 +16,11 @@ setup(
     packages=find_packages(
         include=["stochastic_gradient_push_tpu",
                  "stochastic_gradient_push_tpu.*"]),
+    # the native loader's C++ source ships with the package; data/native.py
+    # builds it on demand (g++ + libjpeg) and falls back to PIL without it
+    package_data={
+        "stochastic_gradient_push_tpu.data": ["native_src/*.cc"],
+    },
     python_requires=">=3.10",
     install_requires=[
         "jax",
