@@ -127,7 +127,11 @@ def run_measurement() -> dict:
     device_kind = jax.devices()[0].device_kind
     mesh = make_gossip_mesh(world)
 
-    model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    # BENCH_S2D=1: the space-to-depth stem (models/resnet.py; equivalent
+    # math, denser MXU tiling) — sweepable on chip next to the default
+    stem_s2d = os.environ.get("BENCH_S2D", "0") == "1"
+    model = resnet50(num_classes=1000, dtype=jnp.bfloat16,
+                     stem_s2d=stem_s2d)
     graph_cls = (NPeerDynamicDirectedExponentialGraph if world > 2
                  else RingGraph)
     graph = graph_cls(world, peers_per_itr=1) if world > 1 else \
@@ -220,6 +224,7 @@ def run_measurement() -> dict:
         "unit": "images/sec/chip",
         "scan": SCAN,
         "batch": BATCH,
+        **({"stem_s2d": True} if stem_s2d else {}),
         "platform": platform,
         "device": device_kind,
         "step_ms": round(time_per_itr * 1e3, 3),

@@ -20,6 +20,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
        timeout 1200 python examples/bench_flash_blocks.py \
          > "$OUT/flashblocks.txt" 2>"$OUT/flashblocks.err"
        tail -4 "$OUT/flashblocks.txt"
+       echo "== space-to-depth stem vs standard (batch 128) =="
+       BENCH_S2D=1 BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=1 \
+         timeout 600 python "$REPO/bench.py" 2>>"$OUT/s2d.err" \
+         | tail -1 | tee "$OUT/s2d.jsonl"
+       echo "== LM bench (auto blocks + lean CE — re-measure) =="
+       timeout 900 python "$REPO/examples/bench_lm_tpu.py" \
+         > "$OUT/lm.txt" 2>"$OUT/lm.err"
+       tail -6 "$OUT/lm.txt"
        echo "== batch sweep =="
        for BB in 192 256; do
          BENCH_BATCH=$BB BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=0 \

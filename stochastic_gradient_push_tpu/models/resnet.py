@@ -23,7 +23,37 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "RESNETS"]
+           "resnet152", "RESNETS", "space_to_depth", "s2d_stem_kernel"]
+
+
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: pack each ``block x block`` spatial tile into
+    channels — ``[N, H, W, C] -> [N, H/b, W/b, b*b*C]`` with (dy, dx, c)
+    packing order (matched by :func:`s2d_stem_kernel`)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel(k7: jnp.ndarray) -> jnp.ndarray:
+    """Transform the standard ``[7, 7, C, F]`` stride-2 stem kernel into
+    the mathematically equivalent ``[4, 4, 4C, F]`` stride-1 kernel over
+    space-to-depth input (the MLPerf TPU ResNet trick).
+
+    Derivation: ``out[i] = Σ_u k[u] x[2i - 3 + u]``.  Zero-padding the
+    kernel at the FRONT to 8 taps gives ``out[i] = Σ_u k8[u] x[2i-4+u]``
+    — a 4-tap convolution over 2-pixel blocks at stride 1 with block-space
+    padding (2, 1).  The 7x7 stem's skinny 147-deep contraction becomes a
+    dense 192-deep one, which tiles the 128x128 MXU far better than the
+    strided original.
+    """
+    kh, kw, c, f = k7.shape
+    assert kh == 7 and kw == 7, "stem transform is specific to 7x7/2"
+    k8 = jnp.pad(k7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    # [8, 8, C, F] -> [4, dy, 4, dx, C, F] -> [4, 4, dy, dx, C, F]
+    k4 = k8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return k4.reshape(4, 4, 4 * c, f)
 
 ModuleDef = tp.Any
 
@@ -103,6 +133,13 @@ class ResNet(nn.Module):
     dtype: tp.Any = jnp.float32
     bn_momentum: float = 0.9
     small_images: bool = False
+    # space-to-depth stem (MLPerf TPU trick): mathematically equivalent
+    # 4x4/1 conv over 2x2-packed input in place of the 7x7/2 stem; the
+    # stem kernel is drawn as 7x7 with the reference init then
+    # transformed, so the init DISTRIBUTION matches exactly.  Changes the
+    # stem parameter shape — checkpoints don't interchange across the
+    # flag (expected: it is an architecture-layout choice).
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -116,6 +153,19 @@ class ResNet(nn.Module):
         x = jnp.asarray(x, self.dtype)
         if self.small_images:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        elif self.stem_s2d:
+            def s2d_init(key, shape, dtype=jnp.float32):
+                c = shape[2] // 4
+                base = nn.initializers.variance_scaling(
+                    2.0, "fan_out", "normal")(key, (7, 7, c, shape[3]),
+                                              dtype)
+                return s2d_stem_kernel(base)
+
+            x = space_to_depth(x, 2)
+            x = nn.Conv(self.num_filters, (4, 4), (1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        dtype=self.dtype, kernel_init=s2d_init,
+                        name="conv_init")(x)
         else:
             x = conv(self.num_filters, (7, 7), (2, 2),
                      padding=[(3, 3), (3, 3)], name="conv_init")(x)

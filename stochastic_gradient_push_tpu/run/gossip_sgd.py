@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="imagefolder decode path: the native C++ pipeline "
                         "(libjpeg + GIL-free thread pool), pure-PIL, or "
                         "auto (native when it builds)")
+    p.add_argument("--stem_s2d", default="False",
+                   help="space-to-depth ResNet stem (MLPerf TPU trick): "
+                        "equivalent 4x4/1 conv over 2x2-packed input in "
+                        "place of the 7x7/2 stem; better MXU tiling")
     p.add_argument("--data_output", default="f32",
                    choices=["f32", "uint8"],
                    help="loader output: host-normalized float32, or raw "
@@ -294,7 +298,8 @@ def main(argv=None, config_transform=None, extra_args=None):
 
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if args.model in RESNETS:
-        model = RESNETS[args.model](num_classes=cfg.num_classes, dtype=dtype)
+        model = RESNETS[args.model](num_classes=cfg.num_classes, dtype=dtype,
+                                    stem_s2d=_str_bool(args.stem_s2d))
     elif args.model == "tiny_cnn":
         model = TinyCNN(num_classes=cfg.num_classes, dtype=dtype)
     else:

@@ -172,3 +172,36 @@ def test_tiny_cnn_forward():
                                train=True, mutable=["batch_stats"])
     assert out.shape == (2, 10)
     assert "batch_stats" in mutated
+
+
+def test_s2d_stem_is_equivalent():
+    """space-to-depth stem (MLPerf TPU trick): transforming the standard
+    7x7/2 stem kernel with s2d_stem_kernel must reproduce the standard
+    model's logits exactly (fp32 rounding)."""
+    from stochastic_gradient_push_tpu.models.resnet import (
+        resnet18, s2d_stem_kernel, space_to_depth)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 64, 64, 3)), jnp.float32)
+    std = resnet18(num_classes=10)
+    s2d = resnet18(num_classes=10, stem_s2d=True)
+    vs = std.init(jax.random.PRNGKey(0), x, train=False)
+    grafted = dict(vs["params"])
+    grafted["conv_init"] = {
+        "kernel": s2d_stem_kernel(vs["params"]["conv_init"]["kernel"])}
+    out_std = std.apply(vs, x, train=False)
+    out_s2d = s2d.apply({"params": grafted,
+                         "batch_stats": vs["batch_stats"]}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_s2d), np.asarray(out_std),
+                               atol=2e-6)
+    # the packing helper itself round-trips pixels
+    blocks = space_to_depth(x, 2)
+    assert blocks.shape == (2, 32, 32, 12)
+    np.testing.assert_array_equal(
+        np.asarray(blocks[0, 0, 0, :3]), np.asarray(x[0, 0, 0]))
+    # init distribution: the s2d kernel is a transformed 7x7 draw, so its
+    # nonzero mass equals a 7x7 kernel's (one zero-padded row/col)
+    vd = s2d.init(jax.random.PRNGKey(1), x, train=False)
+    kd = np.asarray(vd["params"]["conv_init"]["kernel"])
+    assert kd.shape == (4, 4, 12, 64)
+    assert np.count_nonzero(kd) == 7 * 7 * 3 * 64
