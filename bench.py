@@ -442,7 +442,11 @@ def _latest_tpu_capture() -> dict | None:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if rec.get("platform") == "tpu" and rec.get("value"):
+            # never re-cache a cached line: each fallback must trace to a
+            # LIVE on-chip measurement, not compound staleness round over
+            # round
+            if rec.get("platform") == "tpu" and rec.get("value") \
+                    and not rec.get("cached"):
                 rec["cached"] = True
                 rec["cached_from"] = f"docs/tpu_runs/{run}"
                 return rec

@@ -17,7 +17,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     *) echo "[$(date -u +%H:%M:%S)] probe $N: ALIVE: $KIND"
        mkdir -p "$OUT"
        echo "== flash block sweep =="
-       timeout 1200 python examples/bench_flash_blocks.py \
+       timeout 1200 python "$REPO/examples/bench_flash_blocks.py" \
          > "$OUT/flashblocks.txt" 2>"$OUT/flashblocks.err"
        tail -4 "$OUT/flashblocks.txt"
        echo "== space-to-depth stem vs standard (batch 128) =="

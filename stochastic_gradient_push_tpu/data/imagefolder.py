@@ -133,7 +133,15 @@ class ImageFolderDataset:
         self.epoch = int(epoch)
 
     def __getitem__(self, idx: int) -> tuple[np.ndarray, np.int32]:
+        return (self.decode(idx), self.labels[idx])
+
+    def decode(self, idx: int, raw: bool = False) -> np.ndarray:
+        """One decoded image: normalized float32, or pre-normalization
+        uint8 pixels when ``raw`` (the device-side-normalize pipeline).
+        The ONLY place the per-sample rng meets the transform — every
+        backend/output variant routes through here or replays the same
+        :func:`augmentation_rng` stream."""
         rng = (augmentation_rng(self.seed, self.epoch, idx)
                if self.train else None)
-        return (load_image(self.paths[idx], self.image_size, self.train,
-                           rng), self.labels[idx])
+        return load_image(self.paths[idx], self.image_size, self.train,
+                          rng, raw=raw)

@@ -114,16 +114,10 @@ class StreamingImageFolder:
         flat = idx_block.reshape(-1)
         if self.decoder is not None:
             images = self.decoder.decode(flat, output=self.output)
-        elif self.output == "uint8":
-            from .imagefolder import augmentation_rng, load_image
-            ds = self.dataset
-            images = np.stack([
-                load_image(ds.paths[i], ds.image_size, ds.train,
-                           augmentation_rng(ds.seed, ds.epoch, i)
-                           if ds.train else None, raw=True)
-                for i in flat])
         else:
-            images = np.stack([self.dataset[i][0] for i in flat])
+            images = np.stack([
+                self.dataset.decode(i, raw=self.output == "uint8")
+                for i in flat])
         labels = np.asarray([self.dataset.labels[i] for i in flat],
                             np.int32)
         s = self.dataset.image_size

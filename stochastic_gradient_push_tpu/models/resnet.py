@@ -31,6 +31,10 @@ def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
     channels — ``[N, H, W, C] -> [N, H/b, W/b, b*b*C]`` with (dy, dx, c)
     packing order (matched by :func:`s2d_stem_kernel`)."""
     n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"stem_s2d requires spatial dims divisible by {block}, got "
+            f"{h}x{w} — use the standard stem for odd image sizes")
     x = x.reshape(n, h // block, block, w // block, block, c)
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(
         n, h // block, w // block, block * block * c)

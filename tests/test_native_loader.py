@@ -238,8 +238,10 @@ def test_decode_batch_validates_buffers(image_root):
     boxes = np.zeros((1, 5), np.int32)
     small = np.zeros((1, 8, 8, 3), np.float32)
     with pytest.raises(ValueError):
-        native.decode_batch(paths, boxes, small, 32, 1, True)
+        native.decode_batch(paths, boxes, small, 32, 1, 0)
     with pytest.raises(ValueError):
         native.decode_batch(paths, np.zeros((1, 2), np.int32),
-                            np.zeros((1, 32, 32, 3), np.float32), 32, 1,
-                            True)
+                            np.zeros((1, 32, 32, 3), np.float32), 32, 1, 0)
+    with pytest.raises(ValueError):
+        native.decode_batch(paths, boxes,
+                            np.zeros((1, 32, 32, 3), np.float32), 32, 1, 7)
