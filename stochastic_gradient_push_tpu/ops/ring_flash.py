@@ -239,7 +239,7 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
-                         block: int = 128, interpret: bool = False,
+                         block: int | None = None, interpret: bool = False,
                          use_pallas: bool | None = None):
     """Exact ring attention with flash-kernel ticks.
 
@@ -248,7 +248,9 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
         head_dim]``; must be called inside ``shard_map``.
       axis_name: mesh axis the sequence is sharded over.
       causal: causal masking consistent with contiguous block layout.
-      block: flash kernel block size within each tick.
+      block: flash kernel block size within each tick; None = the
+        measured auto rule (flash_attention.default_block) on the local
+        shard length.
       interpret: run the Pallas kernels through the interpreter
         (CPU tests of the real kernel path).
       use_pallas: force the kernel choice; default auto — Pallas on TPU
@@ -256,5 +258,9 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     """
     if use_pallas is None:
         use_pallas = interpret or jax.default_backend() == "tpu"
+    if block is None:
+        from .flash_attention import default_block
+
+        block = default_block(q.shape[2])
     return _ring_flash(q, k, v, axis_name, causal, use_pallas, interpret,
                        block)

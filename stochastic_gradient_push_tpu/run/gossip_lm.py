@@ -97,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--print_freq", default=10, type=int)
     p.add_argument("--seed", default=47, type=int)
     p.add_argument("--corpus_tokens", default=500_000, type=int)
+    p.add_argument("--corpus_file", default=None,
+                   help="real corpus: .npy/.npz pre-tokenized int array, "
+                        "or any file read as raw bytes (byte-level LM, "
+                        "vocab_size >= 256); default: synthetic Markov")
     p.add_argument("--checkpoint_dir", default="./checkpoints", type=str)
     p.add_argument("--tag", default="lm_", type=str)
     p.add_argument("--ckpt_every", default=0, type=int,
@@ -548,8 +552,15 @@ def main(argv=None):
             ckpt.save(host_local_slice(st) if proc_count > 1 else st,
                       {"step": step})
 
-    corpus = synthetic_lm_corpus(args.corpus_tokens,
-                                 vocab_size=args.vocab_size, seed=args.seed)
+    if args.corpus_file:
+        from ..data.lm import load_corpus
+
+        corpus = load_corpus(args.corpus_file, args.vocab_size)
+        log.info(f"corpus: {args.corpus_file} ({len(corpus):,} tokens)")
+    else:
+        corpus = synthetic_lm_corpus(args.corpus_tokens,
+                                     vocab_size=args.vocab_size,
+                                     seed=args.seed)
     val_corpus = None
     if val_on:
         # hold out the corpus tail; at least one full validation batch

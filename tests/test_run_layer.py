@@ -415,3 +415,29 @@ def test_parse_and_plot_lm_csv(tmp_path):
     assert (tmp_path / "lm.png").exists()
     import matplotlib.pyplot
     matplotlib.pyplot.close(fig)
+
+
+def test_load_corpus_variants(tmp_path):
+    """--corpus_file: .npy token arrays validated against vocab; other
+    files read as byte-level corpora (vocab >= 256 enforced)."""
+    import numpy as np
+    import pytest
+
+    from stochastic_gradient_push_tpu.data.lm import load_corpus
+
+    npy = tmp_path / "toks.npy"
+    np.save(npy, np.arange(100) % 30)
+    arr = load_corpus(str(npy), 256)
+    assert arr.dtype == np.int32 and arr.shape == (100,)
+    with pytest.raises(ValueError, match="outside vocab_size"):
+        load_corpus(str(npy), 16)
+    bad = tmp_path / "f.npy"
+    np.save(bad, np.linspace(0, 1, 10))
+    with pytest.raises(ValueError, match="integer"):
+        load_corpus(str(bad), 256)
+    txt = tmp_path / "c.txt"
+    txt.write_bytes(b"abc" * 50)
+    b = load_corpus(str(txt), 256)
+    assert b.shape == (150,) and int(b.max()) < 256
+    with pytest.raises(ValueError, match="vocab_size >= 256"):
+        load_corpus(str(txt), 100)
