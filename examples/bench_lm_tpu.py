@@ -178,14 +178,19 @@ if __name__ == "__main__":
                                   "error": repr(e)[:300]}), flush=True)
                 return None
 
-    for cfg in parse_configs():
-        # flash vs blockwise per config: isolates the Pallas kernels'
-        # effect on the full train step, and a Mosaic rejection of one
-        # variant cannot strand the other's numbers
-        for attn in ("flash", "blockwise"):
-            run_retrying(*cfg, attn=attn)
+    # Priority order (the tunnel window may close any minute — round 4's
+    # 900 s timeout cut t4096 and MoE entirely): every config's flash
+    # number first, then MoE, then the redundant blockwise comparisons
+    # (bench_flash_tpu.py already isolates flash-vs-XLA at the kernel
+    # level, so blockwise full-step numbers are corroboration, not
+    # primary evidence).
+    configs = parse_configs()
+    for cfg in configs:
+        run_retrying(*cfg, attn="flash")
     # MoE throughput on one chip: the full switch dispatch (router,
     # capacity slots, dispatch/combine einsums) with all experts local —
     # the ep>1 meshes need multiple devices, but the routing machinery's
     # cost is visible here (VERDICT r3 item 1c, single-chip variant)
     run_retrying(768, 12, 12, 1024, 8, attn="flash", moe_experts=8)
+    for cfg in configs:
+        run_retrying(*cfg, attn="blockwise")
