@@ -1,0 +1,115 @@
+"""Stride-2 input-grad layout probe (docs/MFU_ANALYSIS.md category 3).
+
+ResNet-50's three stage-transition 3x3/stride-2 convolutions transpose to
+fractionally-strided convs in the backward pass — scattered writes with
+poor MXU tiling at exactly the layers carrying the most channels.  The
+space-to-depth identity that fixed the stem (models/resnet.py:
+``s2d_stem_kernel``) generalizes: a 3x3/2 conv with SAME padding equals a
+2x2/1 conv over 2x2-packed input with a front-padded [2,2,4C,F] kernel,
+whose input-grad is a *dense* stride-1 transpose.
+
+This probe times forward+backward of each downsample conv in both
+formulations (including the space-to-depth transform cost on the s2d
+side — in the full model it would have to fuse or be materialized), and
+checks they compute the same function.  The measured deltas decide
+whether a ``downsample_s2d`` model variant is worth building.
+
+Run on the real chip: ``python examples/bench_stride2_grads.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stochastic_gradient_push_tpu.models.resnet import space_to_depth
+
+BATCH = 128
+# (spatial, C_in, C_out) of the three bottleneck stage-transition 3x3/2
+# convs at ImageNet shapes
+SHAPES = [(56, 128, 128), (28, 256, 256), (14, 512, 512)]
+
+
+def s2d_kernel_3x3(k3: jnp.ndarray) -> jnp.ndarray:
+    """[3,3,C,F] stride-2 SAME kernel -> [2,2,4C,F] stride-1 kernel over
+    space-to-depth input with block-space padding (1, 0)."""
+    c, f = k3.shape[2], k3.shape[3]
+    k4 = jnp.pad(k3, ((1, 0), (1, 0), (0, 0), (0, 0)))  # [4,4,C,F]
+    k2 = k4.reshape(2, 2, 2, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return k2.reshape(2, 2, 4 * c, f)
+
+
+def conv_orig(x, k):
+    return jax.lax.conv_general_dilated(
+        x, k, window_strides=(2, 2), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def conv_s2d(x, k2):
+    xs = space_to_depth(x, 2)
+    return jax.lax.conv_general_dilated(
+        xs, k2, window_strides=(1, 1), padding=[(1, 0), (1, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def timeit(fn, *args, steps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    rows = []
+    for spatial, cin, cout in SHAPES:
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(
+            key, (BATCH, spatial, spatial, cin), jnp.bfloat16)
+        k3 = (jax.random.normal(key, (3, 3, cin, cout), jnp.float32)
+              * 0.05).astype(jnp.bfloat16)
+        k2 = s2d_kernel_3x3(k3)
+
+        # equivalence check (fp32 accumulate; bf16 inputs)
+        y0 = np.asarray(conv_orig(x, k3))
+        y1 = np.asarray(conv_s2d(x, k2))
+        err = float(np.max(np.abs(y0 - y1)) / (np.max(np.abs(y0)) + 1e-9))
+        assert err < 5e-2, (
+            f"s2d formulation diverged (rel_err {err:.3e}) — timings "
+            "below would compare different functions")
+
+        def loss_orig(x, k):
+            return jnp.sum(jnp.square(conv_orig(x, k)))
+
+        def loss_s2d(x, k):
+            return jnp.sum(jnp.square(conv_s2d(x, k)))
+
+        g_orig = jax.jit(jax.grad(loss_orig, argnums=(0, 1)))
+        g_s2d = jax.jit(jax.grad(loss_s2d, argnums=(0, 1)))
+        f_orig = jax.jit(conv_orig)
+        f_s2d = jax.jit(conv_s2d)
+
+        fwd0 = timeit(f_orig, x, k3)
+        fwd1 = timeit(f_s2d, x, k2)
+        bwd0 = timeit(g_orig, x, k3)
+        bwd1 = timeit(g_s2d, x, k2)
+        rows.append((spatial, cin, cout, err, fwd0, fwd1, bwd0, bwd1))
+        print(f"[{spatial}x{spatial}x{cin}->{cout}] rel_err={err:.2e}  "
+              f"fwd {fwd0:.3f} -> {fwd1:.3f} ms  "
+              f"fwd+bwd {bwd0:.3f} -> {bwd1:.3f} ms  "
+              f"bwd_speedup={bwd0 / bwd1:.2f}x", flush=True)
+
+    tot0 = sum(r[6] for r in rows)
+    tot1 = sum(r[7] for r in rows)
+    print(f"TOTAL fwd+bwd over downsample convs: {tot0:.2f} -> {tot1:.2f} "
+          f"ms/step ({tot0 - tot1:+.2f} ms available)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-5 MFU experiments on the real chip (docs/MFU_ANALYSIS.md levers):
+#   1. BENCH_NORM=folded  — BN-folded attribution probe: the step-time
+#                           delta vs baseline IS the BN reduction cost
+#   2. BENCH_NORM=bn16    — compute-dtype batch stats (halved stats traffic)
+#   3. stride-2 grads     — s2d downsample identity: is a dense stride-1
+#                           input-grad faster than the fractionally-strided?
+#   4. s2d stem A/B       — chip effect of the landed stem (round-4 queue)
+#   5. flash (bq,bk) asymmetric sweep incl. t=1024/non-causal (round-4 queue)
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+OUT="${OUT:-$REPO/docs/tpu_runs/$(date -u +%Y%m%dT%H%M%S)_mfu}"
+mkdir -p "$OUT"
+cd "$REPO"
+
+KIND=$(timeout 75 python -c "import jax; print(jax.devices()[0].device_kind)" 2>/dev/null)
+case "$KIND" in
+  *[Cc]pu*|"") echo "tunnel down ('$KIND'); aborting" | tee "$OUT/ABORTED"; exit 1;;
+esac
+echo "chip: $KIND" | tee "$OUT/chip.txt"
+
+echo "== norm variants (batch 128, scan 5; bn = same-window baseline) =="
+for NV in bn folded bn16; do
+  BENCH_NORM=$NV BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=1 \
+    timeout 600 python bench.py 2>>"$OUT/norm.err" \
+    | tail -1 | tee -a "$OUT/norm.jsonl"
+done
+
+echo "== stride-2 input-grad layout probe =="
+timeout 600 python examples/bench_stride2_grads.py \
+  > "$OUT/stride2.txt" 2>"$OUT/stride2.err"
+tail -5 "$OUT/stride2.txt"
+
+echo "== s2d stem A/B (batch 128) =="
+BENCH_S2D=1 BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=1 \
+  timeout 600 python bench.py 2>"$OUT/s2d.err" \
+  | tail -1 | tee "$OUT/s2d.jsonl"
+
+echo "== flash asymmetric (bq,bk) sweep =="
+timeout 1500 python examples/bench_flash_blocks.py \
+  > "$OUT/flashblocks.txt" 2>"$OUT/flashblocks.err"
+tail -6 "$OUT/flashblocks.txt"
+
+echo "== done: $OUT =="
+ls -la "$OUT"

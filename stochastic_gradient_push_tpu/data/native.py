@@ -41,7 +41,10 @@ __all__ = ["ensure_built", "get_native", "NativeDecoder"]
 
 _DATA_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DATA_DIR, "native_src", "loader.cc")
-_SO = os.path.join(_DATA_DIR, "_nativeloader.so")
+# the interpreter's cache tag in the filename forces a rebuild after a
+# Python upgrade — mtime-vs-source alone can't see an ABI change
+_TAG = getattr(sys.implementation, "cache_tag", None) or "py"
+_SO = os.path.join(_DATA_DIR, f"_nativeloader.{_TAG}.so")
 _LOCK = threading.Lock()
 _MODULE: tp.Any = None
 _TRIED = False
@@ -104,7 +107,21 @@ def get_native() -> tp.Any | None:
         spec = importlib.util.spec_from_file_location("_nativeloader", so)
         assert spec and spec.loader
         mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        try:
+            spec.loader.exec_module(mod)
+        except Exception as e:
+            # a corrupt or foreign-ABI cached extension degrades to PIL
+            # (as documented) instead of crashing the loader; drop the
+            # bad .so so the next process rebuilds it
+            try:
+                os.unlink(so)
+            except OSError:
+                pass
+            if mode == "require":
+                raise RuntimeError(
+                    f"SGP_NATIVE_LOADER=require but the built extension "
+                    f"failed to import: {e}") from e
+            return None
         _MODULE = mod
         return _MODULE
 

@@ -447,16 +447,42 @@ PyObject* py_decode_batch(PyObject*, PyObject* args) {
   }
 
   BufferGuard boxes_g, out_g;
-  if (!get_buffer(boxes_obj, boxes_g, PyBUF_C_CONTIGUOUS, "boxes"))
+  if (!get_buffer(boxes_obj, boxes_g, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT,
+                  "boxes"))
     return nullptr;
-  if (!get_buffer(out_obj, out_g, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE, "out"))
+  if (!get_buffer(out_obj, out_g,
+                  PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT, "out"))
     return nullptr;
+  // itemsize (and format when exported) pin the element type: byte-length
+  // alone would let e.g. an int64 boxes array of sufficient size be
+  // silently reinterpreted as int32 garbage crop boxes
+  if (boxes_g.view.itemsize != static_cast<Py_ssize_t>(sizeof(int32_t)) ||
+      (boxes_g.view.format != nullptr &&
+       strcmp(boxes_g.view.format, "i") != 0 &&
+       strcmp(boxes_g.view.format, "l") != 0)) {
+    PyErr_Format(PyExc_TypeError,
+                 "boxes must be int32 (itemsize %zd, format %s)",
+                 boxes_g.view.itemsize,
+                 boxes_g.view.format ? boxes_g.view.format : "?");
+    return nullptr;
+  }
   if (boxes_g.view.len < static_cast<Py_ssize_t>(n * 5 * sizeof(int32_t))) {
     PyErr_SetString(PyExc_ValueError, "boxes buffer too small (need n*5 i32)");
     return nullptr;
   }
   const size_t per_img = static_cast<size_t>(out_size) * out_size * 3;
   const size_t elem = mode == OutMode::kU8 ? 1 : sizeof(float);
+  const char* want_fmt = mode == OutMode::kU8 ? "B" : "f";
+  if (out_g.view.itemsize != static_cast<Py_ssize_t>(elem) ||
+      (out_g.view.format != nullptr &&
+       strcmp(out_g.view.format, want_fmt) != 0)) {
+    PyErr_Format(PyExc_TypeError,
+                 "out must be %s for this mode (itemsize %zd, format %s)",
+                 mode == OutMode::kU8 ? "uint8" : "float32",
+                 out_g.view.itemsize,
+                 out_g.view.format ? out_g.view.format : "?");
+    return nullptr;
+  }
   if (out_g.view.len < static_cast<Py_ssize_t>(n * per_img * elem)) {
     PyErr_SetString(PyExc_ValueError, "out buffer too small");
     return nullptr;

@@ -21,9 +21,11 @@ from functools import partial
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "RESNETS", "space_to_depth", "s2d_stem_kernel"]
+           "resnet152", "RESNETS", "space_to_depth", "s2d_stem_kernel",
+           "ProbeBatchNorm"]
 
 
 def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
@@ -60,6 +62,78 @@ def s2d_stem_kernel(k7: jnp.ndarray) -> jnp.ndarray:
     return k4.reshape(4, 4, 4 * c, f)
 
 ModuleDef = tp.Any
+
+
+class ProbeBatchNorm(nn.Module):
+    """BatchNorm with the two MFU-experiment knobs docs/MFU_ANALYSIS.md
+    names for the bandwidth-bound backward phase:
+
+    * ``stats_dtype=bfloat16`` — compute the batch mean/variance
+      reductions in the compute dtype instead of flax's always-float32
+      promotion, halving the statistics' HBM read traffic and removing
+      the fp32 materialization between conv fusions.  The running-stat
+      EMA stays float32.
+    * ``frozen=True`` — normalize with the *running* statistics even in
+      training (per-channel affine only: no batch reductions forward, no
+      statistics term backward).  Not a training configuration — it is
+      the BN-*folded* benchmark variant whose step-time delta ATTRIBUTES
+      the cost of BN's reduction passes.
+
+    Per-layer variables ("scale"/"bias" params, "mean"/"var"
+    batch_stats, float32) match ``nn.BatchNorm``, so train-state
+    plumbing and replication are unchanged; the ``frozen`` mode
+    self-assigns the running stats so the ``batch_stats`` collection is
+    still mutated and the train step's state threading (train/step.py)
+    needs no special case.  Flax auto-names embed the class name
+    (``ProbeBatchNorm_0`` vs ``BatchNorm_0``), so checkpoints do NOT
+    interchange across ``norm_variant`` — same caveat as ``stem_s2d``.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: tp.Any = jnp.float32
+    stats_dtype: tp.Any = None  # None -> float32 (flax semantics)
+    frozen: bool = False
+    scale_init: tp.Callable = nn.initializers.ones
+    bias_init: tp.Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        feat = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((feat,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((feat,), jnp.float32))
+        scale = self.param("scale", self.scale_init, (feat,), jnp.float32)
+        bias = self.param("bias", self.bias_init, (feat,), jnp.float32)
+
+        if self.use_running_average or self.frozen:
+            mean, var = ra_mean.value, ra_var.value
+            if self.frozen and not self.use_running_average \
+                    and not self.is_initializing():
+                ra_mean.value = ra_mean.value  # keep collection mutated
+                ra_var.value = ra_var.value
+        else:
+            sdt = self.stats_dtype or jnp.float32
+            xs = x.astype(sdt)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(xs, axes)
+            # fast variance (E[x^2] - E[x]^2), as flax's default; the
+            # cancellation can go NEGATIVE in bf16 (8-bit mantissa), and
+            # rsqrt of a negative is NaN — clamp
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xs), axes) - jnp.square(mean), 0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = (m * ra_mean.value
+                                 + (1 - m) * mean.astype(jnp.float32))
+                ra_var.value = (m * ra_var.value
+                                + (1 - m) * var.astype(jnp.float32))
+        cdt = self.dtype
+        inv = lax.rsqrt(var.astype(cdt) + jnp.asarray(
+            self.epsilon, cdt)) * scale.astype(cdt)
+        return (x.astype(cdt) - mean.astype(cdt)) * inv + bias.astype(cdt)
 
 
 class BasicBlock(nn.Module):
@@ -144,15 +218,32 @@ class ResNet(nn.Module):
     # stem parameter shape — checkpoints don't interchange across the
     # flag (expected: it is an architecture-layout choice).
     stem_s2d: bool = False
+    # MFU-experiment norm variants (docs/MFU_ANALYSIS.md): "bn" is flax
+    # BatchNorm (fp32 stats); "bn16" computes batch stats in the compute
+    # dtype (ProbeBatchNorm stats_dtype); "folded" normalizes with the
+    # running stats even in training — a benchmark-only variant that
+    # attributes BN's reduction cost, NOT a training configuration.
+    norm_variant: str = "bn"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        kernel_init=nn.initializers.variance_scaling(
                            2.0, "fan_out", "normal"))
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=self.bn_momentum, epsilon=1e-5,
-                       dtype=self.dtype)
+        if self.norm_variant == "bn":
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=self.bn_momentum, epsilon=1e-5,
+                           dtype=self.dtype)
+        elif self.norm_variant == "bn16":
+            norm = partial(ProbeBatchNorm, use_running_average=not train,
+                           momentum=self.bn_momentum, epsilon=1e-5,
+                           dtype=self.dtype, stats_dtype=self.dtype)
+        elif self.norm_variant == "folded":
+            norm = partial(ProbeBatchNorm, use_running_average=not train,
+                           momentum=self.bn_momentum, epsilon=1e-5,
+                           dtype=self.dtype, frozen=True)
+        else:
+            raise ValueError(f"unknown norm_variant {self.norm_variant!r}")
 
         x = jnp.asarray(x, self.dtype)
         if self.small_images:

@@ -292,10 +292,12 @@ def main(argv=None):
             dtype = (jnp.bfloat16 if args.precision == "bf16"
                      else jnp.float32)
             head_dim = args.d_model // args.n_heads
-            # the run's auto-selected block (Mosaic layouts are
-            # block-shape-specific, so probe the block the run will use)
+            # the run's auto-selected block at the run's FULL seq_len:
+            # Mosaic layouts are shape-specific, so a shorter probe could
+            # pass while the real length still fails.  batch 1 x 1 head
+            # keeps the full-length probe cheap at any seq_len.
             blk = default_block(args.seq_len)
-            t = min(args.seq_len, 2 * blk)
+            t = args.seq_len
             x = jnp.zeros((1, 1, t, head_dim), dtype)
             jax.block_until_ready(
                 flash_attention_forward(x, x, x, causal=True,

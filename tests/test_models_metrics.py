@@ -205,3 +205,82 @@ def test_s2d_stem_is_equivalent():
     kd = np.asarray(vd["params"]["conv_init"]["kernel"])
     assert kd.shape == (4, 4, 12, 64)
     assert np.count_nonzero(kd) == 7 * 7 * 3 * 64
+
+
+def test_probe_batch_norm_variants():
+    """ProbeBatchNorm (models/resnet.py): the MFU-experiment norm
+    variants must keep nn.BatchNorm's exact variable structure and, with
+    float32 stats, its exact math — so bench variants differ ONLY in the
+    lever under test (docs/MFU_ANALYSIS.md)."""
+    import flax.linen as nn
+
+    from stochastic_gradient_push_tpu.models.resnet import ProbeBatchNorm
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 6, 6, 8)), jnp.float32)
+
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                       epsilon=1e-5)
+    probe = ProbeBatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-5)
+    v_ref = ref.init(jax.random.PRNGKey(0), x)
+    v_probe = probe.init(jax.random.PRNGKey(0), x)
+    assert jax.tree.structure(v_ref) == jax.tree.structure(v_probe)
+
+    y_ref, m_ref = ref.apply(v_ref, x, mutable=["batch_stats"])
+    y_probe, m_probe = probe.apply(v_probe, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_probe), np.asarray(y_ref),
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(m_probe["batch_stats"]["mean"]),
+        np.asarray(m_ref["batch_stats"]["mean"]), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(m_probe["batch_stats"]["var"]),
+        np.asarray(m_ref["batch_stats"]["var"]), atol=1e-5)
+
+    # bf16 stats: same function within bf16 tolerance
+    p16 = ProbeBatchNorm(use_running_average=False, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.bfloat16,
+                         stats_dtype=jnp.bfloat16)
+    y16, _ = p16.apply(v_probe, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y16, np.float32),
+                               np.asarray(y_ref), atol=0.1)
+
+    # folded: running stats used in train mode, collection still mutated
+    # (structure preserved for the train step) with values unchanged
+    frozen = ProbeBatchNorm(use_running_average=False, frozen=True)
+    y_frozen, m_frozen = frozen.apply(v_probe, x, mutable=["batch_stats"])
+    assert jax.tree.structure(m_frozen) == jax.tree.structure(m_ref)
+    np.testing.assert_array_equal(
+        np.asarray(m_frozen["batch_stats"]["mean"]),
+        np.asarray(v_probe["batch_stats"]["mean"]))
+    # running stats at init are mean 0 / var 1 -> y = scale*x/sqrt(1+eps)+bias
+    np.testing.assert_allclose(
+        np.asarray(y_frozen), np.asarray(x) / np.sqrt(1 + 1e-5), atol=1e-6)
+
+
+def test_resnet_norm_variant_state_structure():
+    """All three norm variants build the same train-state *shapes* (same
+    parameters, same batch_stats, mutated every step), so BENCH_NORM
+    sweeps the lever without touching any other plumbing.  Flax's
+    auto-names embed the module class (BatchNorm_0 vs ProbeBatchNorm_0),
+    so checkpoints do not interchange across the flag — same caveat as
+    stem_s2d, and irrelevant to the bench, which builds its own state."""
+    from stochastic_gradient_push_tpu.models.resnet import resnet18
+
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    shapes = {}
+    for nv in ("bn", "bn16", "folded"):
+        model = resnet18(num_classes=10, small_images=True,
+                         norm_variant=nv)
+        v = model.init(jax.random.PRNGKey(0), x, train=True)
+        out, mutated = model.apply(v, x, train=True,
+                                   mutable=["batch_stats"])
+        assert np.all(np.isfinite(np.asarray(out))), nv
+        assert "batch_stats" in mutated, nv
+        shapes[nv] = {
+            coll: sorted(jnp.shape(l) for l in jax.tree.leaves(v[coll]))
+            for coll in ("params", "batch_stats")}
+        shapes[nv]["mutated"] = sorted(
+            jnp.shape(l) for l in jax.tree.leaves(mutated["batch_stats"]))
+    assert shapes["bn"] == shapes["bn16"] == shapes["folded"]

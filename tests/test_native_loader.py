@@ -283,3 +283,15 @@ def test_decode_batch_validates_buffers(image_root):
     with pytest.raises(ValueError):
         native.decode_batch(paths, boxes,
                             np.zeros((1, 32, 32, 3), np.float32), 32, 1, 7)
+    # element types are pinned, not just byte lengths: int64 boxes of
+    # sufficient byte size must raise, not be reinterpreted as int32
+    with pytest.raises(TypeError):
+        native.decode_batch(paths, boxes.astype(np.int64),
+                            np.zeros((1, 32, 32, 3), np.float32), 32, 1, 0)
+    # float32 out for the uint8 mode (and vice versa) is a type error
+    with pytest.raises(TypeError):
+        native.decode_batch(paths, boxes,
+                            np.zeros((1, 32, 32, 3), np.float32), 32, 1, 2)
+    with pytest.raises(TypeError):
+        native.decode_batch(paths, boxes,
+                            np.zeros((1, 32, 32, 3), np.uint8), 32, 1, 0)
