@@ -21,9 +21,12 @@ esac
 echo "chip: $KIND" | tee "$OUT/chip.txt"
 
 echo "== norm variants (batch 128, scan 5; bn = same-window baseline) =="
+# folded/bn16 are FRESH XLA programs: the remote compile alone can eat
+# bench.py's default 420 s attempt budget — give each variant a long one
 for NV in bn folded bn16; do
   BENCH_NORM=$NV BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=1 \
-    timeout 600 python bench.py 2>>"$OUT/norm.err" \
+  BENCH_TIMEOUT=1000 BENCH_DEADLINE=1100 \
+    timeout 1200 python bench.py 2>>"$OUT/norm.err" \
     | tail -1 | tee -a "$OUT/norm.jsonl"
 done
 

@@ -34,6 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--all_reduce", default="False", type=str)
     p.add_argument("--push_sum", default="True", type=str)
     p.add_argument("--overlap", default="False", type=str)
+    p.add_argument("--bilat", default="False", type=str,
+                   help="AD-PSGD: bilateral perfect-matching averaging "
+                        "(synchronous formulation; see algorithms.py)")
     p.add_argument("--graph_type", default=5, type=int,
                    choices=list(GRAPH_TOPOLOGIES))
     p.add_argument("--peers_per_itr", default=1, type=int)
@@ -132,7 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="validation batches per evaluation")
     p.add_argument("--profile_dir", default=None, type=str,
                    help="capture a jax.profiler trace of steps 2..4 into "
-                        "this directory (TensorBoard format)")
+                        "this directory (TensorBoard format).  Over "
+                        "tunneled backends the profiler RPC hangs; the "
+                        "run then continues untraced with a warning and "
+                        "the supported attribution is the fwd/fwdbwd "
+                        "probes (docs/MFU_ANALYSIS.md)")
     # multi-host (same surface as gossip_sgd)
     p.add_argument("--multihost", default="auto",
                    choices=["auto", "True", "False"],
@@ -374,6 +381,17 @@ def main(argv=None):
             raise SystemExit(
                 "gossip_every/gossip_comm_dtype are push-sum knobs")
         alg = all_reduce(GOSSIP_AXIS)
+    elif sb(args.bilat):
+        # AD-PSGD (synchronous matching formulation), as in gossip_sgd
+        from ..algorithms import adpsgd
+        from ..topology import build_pairing_schedule
+
+        if args.gossip_every != 1 or args.gossip_comm_dtype:
+            raise SystemExit(
+                "gossip_every/gossip_comm_dtype are push-sum knobs")
+        graph = GRAPH_TOPOLOGIES[args.graph_type](
+            dp, peers_per_itr=args.peers_per_itr)
+        alg = adpsgd(build_pairing_schedule(graph), GOSSIP_AXIS)
     else:
         graph = GRAPH_TOPOLOGIES[args.graph_type](
             dp, peers_per_itr=args.peers_per_itr)
@@ -717,13 +735,22 @@ def main(argv=None):
                 jax.block_until_ready(state)
             steps_done += 1
             if args.profile_dir and not prof_stopped:
-                # bounded trace window: steps 2-4 (step 1 pays the compile)
+                # bounded trace window: steps 2-4 (step 1 pays the
+                # compile).  Guarded: over a tunneled backend the
+                # profiler RPC hangs, so a timed-out start/stop degrades
+                # to probe-only attribution instead of stalling the run
+                # (utils/profiling.py tunnel caveat)
+                from ..utils.profiling import (start_trace_guarded,
+                                               stop_trace_guarded)
+
                 if not prof_started and steps_done == start_step + 1:
-                    jax.profiler.start_trace(args.profile_dir)
-                    prof_started = True
+                    if start_trace_guarded(args.profile_dir):
+                        prof_started = True
+                    else:
+                        prof_stopped = True  # don't retry a hung profiler
                 elif prof_started and steps_done >= start_step + 4:
                     jax.block_until_ready(state)
-                    jax.profiler.stop_trace()
+                    stop_trace_guarded()
                     prof_stopped = True
             if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
                 guard = (watchdog.step()
@@ -767,7 +794,9 @@ def main(argv=None):
         ckpt.wait()  # async saves must land before exit
         ckpt.close()
     if prof_started and not prof_stopped:
-        jax.profiler.stop_trace()
+        from ..utils.profiling import stop_trace_guarded
+
+        stop_trace_guarded()
 
     result = {"final_loss": loss_meter.val, "avg_loss": loss_meter.avg,
               "tokens_per_sec": tokens_per_step

@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_dataloader_workers", default=8, type=int,
                    help="decode worker threads for the imagefolder "
                         "streaming loader (synthetic data ignores this)")
+    p.add_argument("--prefetch", default="False", type=str,
+                   help="overlap host->device batch transfer with the "
+                        "previous step (data/prefetch.py; single-process "
+                        "non-scanned runs)")
     p.add_argument("--data_backend", default="auto",
                    choices=["auto", "native", "pil"],
                    help="imagefolder decode path: the native C++ pipeline "
@@ -222,6 +226,7 @@ def parse_config(argv=None):
         num_classes=args.num_classes,
         scan_steps=args.scan_steps,
         num_dataloader_workers=args.num_dataloader_workers,
+        prefetch=_str_bool(args.prefetch),
         gossip_every=args.gossip_every,
         cosine_lr=_str_bool(args.cosine_lr),
         label_smoothing=args.label_smoothing,

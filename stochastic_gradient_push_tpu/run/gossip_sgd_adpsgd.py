@@ -17,21 +17,32 @@ from __future__ import annotations
 
 import argparse
 
+from .gossip_sgd import _str_bool
 from .gossip_sgd import main as base_main
 
 __all__ = ["main"]
 
 
 def main(argv=None):
-    # peel off the AD-PSGD-specific flag, forward the rest
+    # peel off the AD-PSGD-specific flags, forward the rest
     peel = argparse.ArgumentParser(add_help=False)
     peel.add_argument("--num_peers", default=1, type=int)
     peel.add_argument("--graph_type", default=1, type=int)
+    peel.add_argument("--bilat_async", default="False", type=str,
+                      help="True: REAL wall-clock asynchrony — bilateral "
+                           "averaging on a host thread off the compiled "
+                           "step (train/async_bilat.py, ≙ the reference's "
+                           "separate averaging process)")
+    peel.add_argument("--bilat_async_interval", default=0.0, type=float,
+                      help="min seconds between host averaging rounds "
+                           "(0 = unpaced); raising it widens staleness")
     known, rest = peel.parse_known_args(argv)
     forwarded = rest + ["--graph_type", str(known.graph_type)]
 
     def to_bilat(cfg, args):
         cfg.bilat = True
+        cfg.bilat_async = _str_bool(known.bilat_async)
+        cfg.bilat_async_interval = known.bilat_async_interval
         cfg.ppi_schedule = {0: known.num_peers}
         return cfg
 

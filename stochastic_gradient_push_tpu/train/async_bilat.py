@@ -1,0 +1,164 @@
+"""Wall-clock-asynchronous AD-PSGD: host-side bilateral averaging that
+overlaps the compiled train step.
+
+The reference runs bilateral averaging in a SEPARATE OS PROCESS with its
+own optimizer, exchanging through shared memory while the gradient
+process keeps stepping (ad_psgd.py:120-133, 252-366) — so the averaging
+a rank receives is stale by however long the averaging process took on a
+hardware clock, not by a fixed step count.  The synchronous matching
+formulation (algorithms.py:BilateralGossip) captures the mixing
+semantics but not that asynchrony; this module is the executable
+counterpart:
+
+* the compiled step carries NO inter-replica collective (the base
+  :class:`~..algorithms.api.GossipAlgorithm` — local SGD);
+* a host thread continuously snapshots the live world-stacked params,
+  computes one bilateral matching round, and deposits the averaging
+  DISPLACEMENT ``(x_partner - x_i)/2`` in a mailbox;
+* the train loop adopts whatever displacement is ready at each step
+  boundary — computed from params as of step ``k``, applied at step
+  ``k + δ`` where δ is set by real host/device timing, exactly the
+  reference's staleness process (intermediate SGD progress is never
+  discarded: the displacement is additive, matching the reference's
+  model where the in-flight gradient lands on post-averaging params).
+
+Per-adoption δ is recorded; :meth:`AsyncBilateralAverager.staleness_summary`
+is the NN-scale measured-staleness evidence docs/STALENESS_STUDY.md's
+quadratic model approximates.  Single-process meshes (one host owning
+all ranks) — the multi-host variant would ship displacements through the
+checkpoint-dir filesystem or a sidecar collective, and is out of scope
+here (ARCHITECTURE.md records the decision).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import typing as tp
+
+import jax
+import numpy as np
+
+__all__ = ["AsyncBilateralAverager"]
+
+
+class AsyncBilateralAverager:
+    """Host-async bilateral averaging over a perfect-matching schedule.
+
+    Args:
+      pairing: ``[n_phases, world]`` partner table from
+        :func:`~..topology.build_pairing_schedule` (row r, column i =
+        i's partner in phase r; involutions).
+      min_interval_s: minimum wall-clock gap between averaging rounds —
+        0 averages as fast as the host can (the reference's averaging
+        process is likewise unpaced); raising it emulates a slower
+        averaging path and WIDENS the measured staleness.
+    """
+
+    def __init__(self, pairing: np.ndarray, min_interval_s: float = 0.0):
+        self.pairing = np.asarray(pairing)
+        if self.pairing.ndim != 2:
+            raise ValueError("pairing must be [n_phases, world]")
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._published: tuple[int, tp.Any] | None = None
+        self._mailbox: tuple[int, tp.Any] | None = None
+        self._last_read_step = -1
+        self._phase = 0
+        self._adoptions: list[tuple[int, int]] = []  # (read, adopted)
+        self._rounds = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- train-loop side ---------------------------------------------------
+
+    def start(self) -> "AsyncBilateralAverager":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="async-bilat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def publish(self, step: int, params) -> None:
+        """Expose the live params (world-stacked device arrays) to the
+        averaging thread.
+
+        The arrays are COPIED on device first: the train step is
+        compiled with ``donate_argnums=(0,)``, so the state buffers the
+        loop just received are deleted the moment the NEXT step
+        dispatches — a thread still reading them would hit "Array has
+        been deleted".  The copy dispatches before that next step and
+        device execution is ordered, so the snapshot is safe; cost is
+        one extra params-sized allocation, off the timed path."""
+        import jax.numpy as jnp
+
+        snap = jax.tree.map(jnp.copy, params)
+        with self._lock:
+            self._published = (int(step), snap)
+
+    def maybe_adopt(self, step: int, params):
+        """Apply a ready displacement, if any.  Returns ``(params,
+        adopted)`` — the addition preserves every SGD update made since
+        the displacement was read (staleness, not lost work)."""
+        with self._lock:
+            box, self._mailbox = self._mailbox, None
+        if box is None:
+            return params, False
+        read_step, disp = box
+        self._adoptions.append((read_step, int(step)))
+        new = jax.tree.map(
+            lambda p, d: p + jax.numpy.asarray(d, p.dtype), params, disp)
+        return new, True
+
+    def staleness_summary(self) -> dict:
+        """Measured hardware-clock staleness, in steps."""
+        if not self._adoptions:
+            return {"adoptions": 0, "rounds": self._rounds}
+        d = np.array([a - r for r, a in self._adoptions])
+        return {"adoptions": len(d), "rounds": self._rounds,
+                "staleness_mean": float(d.mean()),
+                "staleness_p50": float(np.median(d)),
+                "staleness_max": int(d.max())}
+
+    # -- averaging-thread side ---------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    snap = self._published
+                if snap is None or snap[0] == self._last_read_step:
+                    time.sleep(0.001)  # nothing new published yet
+                    continue
+                step, params = snap
+                self._last_read_step = step
+                host = jax.device_get(params)  # [world, ...] numpy pytree
+                partner = self.pairing[self._phase % len(self.pairing)]
+                self._phase += 1
+                disp = jax.tree.map(
+                    lambda a: (a[partner] - a) * 0.5, host)
+                with self._lock:
+                    # overwrite-don't-queue: like the reference's shared
+                    # buffer, only the newest averaging result survives
+                    self._mailbox = (step, disp)
+                self._rounds += 1
+                if self.min_interval_s:
+                    # interruptible pacing: stop() must not wait out a
+                    # long interval (and a post-stop round would read
+                    # buffers the loop has moved past)
+                    self._stop.wait(self.min_interval_s)
+        except BaseException:  # a dead thread must never be silent:
+            # training would keep running as local SGD while reporting
+            # itself as AD-PSGD
+            import traceback
+
+            from ..utils.logging import make_logger
+
+            make_logger("async-bilat").error(
+                "averaging thread died — training continues WITHOUT "
+                f"bilateral averaging:\n{traceback.format_exc()}")
+            raise

@@ -66,6 +66,15 @@ class TrainerConfig:
     # ICI traffic with bounded quantization error
     gossip_comm_dtype: str | None = None
     bilat: bool = False                       # AD-PSGD family
+    # AD-PSGD with REAL wall-clock asynchrony: the compiled step carries
+    # no collective; a host thread averages bilaterally off the hot path
+    # and the loop adopts stale displacements (train/async_bilat.py,
+    # ≙ the reference's separate averaging process, ad_psgd.py:120-133).
+    # Single-process meshes only.  Implies/overrides ``bilat``.
+    bilat_async: bool = False
+    # minimum seconds between host averaging rounds (0 = unpaced, like
+    # the reference); raising it widens the measured staleness
+    bilat_async_interval: float = 0.0
     graph_class: tp.Any = None                # GraphTopology subclass
     mixing_class: tp.Any = None               # MixingStrategy subclass
     ppi_schedule: dict[int, int] = dataclasses.field(
@@ -109,6 +118,12 @@ class TrainerConfig:
     scan_steps: int = 1
     # decode workers for streaming loaders (reported in the CSV preamble)
     num_dataloader_workers: int = 0
+    # overlap host->device batch transfer with the previous step's compute
+    # (data/prefetch.py).  Single-process, non-scanned path only —
+    # elsewhere it logs once and stays off.  Measured on chip before any
+    # default change (docs/MFU_ANALYSIS.md round-5 prefetch probe).
+    prefetch: bool = False
+    prefetch_depth: int = 2
     # heartbeat: log loudly when a blocking step exceeds this many seconds
     # (a stalled multi-host collective; ≙ distributed.py:36); 0 disables
     heartbeat_timeout: int = 300
@@ -177,6 +192,8 @@ class Trainer:
         self.watchdog = (StepWatchdog(timeout=config.heartbeat_timeout,
                                       rank=self.proc_index)
                          if config.heartbeat_timeout > 0 else None)
+        self._async_bilat = None  # built per-fit when cfg.bilat_async
+        self._warned_prefetch = False
 
         # per-rank files: each process writes its local ranks; the single
         # aggregate file is process 0's job
@@ -210,6 +227,10 @@ class Trainer:
                 "family only")
         if cfg.all_reduce:
             return all_reduce(axis)
+        if cfg.bilat_async:
+            # no collective in the compiled step: the bilateral averaging
+            # runs host-side (train/async_bilat.py); pure local SGD here
+            return GossipAlgorithm()
         graph = cfg.graph_class(self.gossip_world, peers_per_itr=ppi)
         if cfg.bilat:
             return adpsgd(build_pairing_schedule(graph), axis)
@@ -384,6 +405,50 @@ class Trainer:
             self.log.info(f"resumed from epoch {start_epoch} itr {start_itr}")
 
         begin_time = time.time() - elapsed
+        if cfg.bilat_async:
+            if self.proc_count > 1:
+                raise ValueError(
+                    "bilat_async averages on one host thread and is "
+                    "single-process only (see train/async_bilat.py)")
+            if cfg.graph_class is None:
+                raise ValueError("bilat_async needs a graph_class for "
+                                 "the matching schedule")
+            from .async_bilat import AsyncBilateralAverager
+
+            graph = cfg.graph_class(self.gossip_world, peers_per_itr=1)
+            self._async_bilat = AsyncBilateralAverager(
+                build_pairing_schedule(graph),
+                min_interval_s=cfg.bilat_async_interval).start()
+        try:
+            state, best_prec1, final_prec1 = self._fit_epochs(
+                state, train_loader, sampler, val_loader, itr_per_epoch,
+                meters, start_epoch, start_itr, best_prec1, begin_time)
+        finally:
+            if self._async_bilat is not None:
+                self._async_bilat.stop()
+                self.log.info("async bilateral staleness: "
+                              f"{self._async_bilat.staleness_summary()}")
+
+        if cfg.train_fast and val_loader is not None:
+            alg = self._train_fn(
+                ppi_at_epoch(cfg.ppi_schedule, cfg.num_epochs - 1)
+                if not cfg.all_reduce else 1, itr_per_epoch)[0]
+            final_prec1 = self.validate(state, alg, val_loader)
+            self.log.info(f"Test accuracy: {final_prec1}")
+
+        result = {"best_prec1": float(best_prec1),
+                  "final_prec1": float(final_prec1),
+                  "elapsed_time": time.time() - begin_time,
+                  "batch_meter": meters[0]}
+        if self._async_bilat is not None:
+            result["async_bilat"] = self._async_bilat.staleness_summary()
+        return state, result
+
+    def _fit_epochs(self, state, train_loader, sampler, val_loader,
+                    itr_per_epoch, meters, start_epoch, start_itr,
+                    best_prec1, begin_time):
+        cfg = self.cfg
+        batch_meter, nn_meter, data_meter = meters
         final_prec1 = 0.0
         for epoch in range(start_epoch, cfg.num_epochs):
             sampler.set_epoch(epoch + cfg.seed * 90)  # gossip_sgd.py:289
@@ -435,17 +500,7 @@ class Trainer:
                         save_state, meta, epoch_id=epoch_id, is_best=is_best,
                         requeue_on_signal=(epoch != cfg.num_epochs - 1))
 
-        if cfg.train_fast and val_loader is not None:
-            alg = self._train_fn(
-                ppi_at_epoch(cfg.ppi_schedule, cfg.num_epochs - 1)
-                if not cfg.all_reduce else 1, itr_per_epoch)[0]
-            final_prec1 = self.validate(state, alg, val_loader)
-            self.log.info(f"Test accuracy: {final_prec1}")
-
-        return state, {"best_prec1": float(best_prec1),
-                       "final_prec1": float(final_prec1),
-                       "elapsed_time": time.time() - begin_time,
-                       "batch_meter": batch_meter}
+        return state, best_prec1, final_prec1
 
     def _restore(self, state):
         """Checkpoint restore; multi-host either restores the global
@@ -479,6 +534,18 @@ class Trainer:
 
         if start_itr:
             loader.fast_forward(start_itr)
+        if cfg.prefetch:
+            if self.proc_count == 1 and cfg.scan_steps == 1:
+                from ..data.prefetch import DevicePrefetcher
+
+                loader = DevicePrefetcher(
+                    loader, self.mesh, self._batch_spec(scanned=False),
+                    depth=cfg.prefetch_depth)
+            elif not self._warned_prefetch:
+                self.log.warning(
+                    "prefetch supports single-process non-scanned runs "
+                    "only; continuing without it")
+                self._warned_prefetch = True
 
         def record(i, metric_slices, chunk, elapsed_nn, elapsed_batch,
                    elapsed_data, timed):
@@ -578,6 +645,17 @@ class Trainer:
             with guard:
                 state, metrics = train_fn(state, x, y)
                 jax.block_until_ready(state)
+            if self._async_bilat is not None:
+                # wall-clock-async AD-PSGD: expose the fresh params to the
+                # host averaging thread and adopt whatever (stale)
+                # displacement it has ready — the thread worked while the
+                # device computed this step
+                gstep = epoch * itr_per_epoch + i + chunk
+                self._async_bilat.publish(gstep, state.params)
+                new_params, adopted = self._async_bilat.maybe_adopt(
+                    gstep, state.params)
+                if adopted:
+                    state = state.replace(params=new_params)
             if self.proc_count > 1:
                 # metrics come back sharded across hosts; all-gather the
                 # tiny per-rank vectors so every process logs full rows

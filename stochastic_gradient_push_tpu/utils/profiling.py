@@ -7,6 +7,15 @@ gossip thread's flag (distributed.py:36, :349-352).  Here:
 * :func:`trace` — ``jax.profiler`` trace context producing TensorBoard-
   loadable XPlane dumps of the actual device timeline (compute/collective
   overlap included), something the reference cannot see at all.
+  TUNNEL CAVEAT: over a tunneled/remote backend (the axon dev setup),
+  ``start_trace``/``stop_trace`` can HANG in the plugin's profiler RPC
+  (measured: round-4 capture burned its full 600 s step on it).  All
+  profiler entry points here therefore run the jax.profiler calls on a
+  guarded timeout thread: if the call doesn't return in ``timeout``
+  seconds the run CONTINUES untraced with a loud warning, and the
+  supported decomposition mechanism is bench.py's ``fwd_ms``/
+  ``fwdbwd_ms`` probes (docs/MFU_ANALYSIS.md).  On local backends (CPU
+  mesh, directly-attached TPU) tracing works normally.
 * :class:`StepWatchdog` — heartbeat for the compiled step.  A hang inside
   one XLA program can't happen the way a lost NCCL broadcast could, but a
   multi-host collective CAN stall if a peer host dies; the watchdog logs
@@ -22,21 +31,110 @@ import time
 
 from .logging import make_logger
 
-__all__ = ["trace", "StepWatchdog", "HEARTBEAT_TIMEOUT"]
+__all__ = ["trace", "start_trace_guarded", "stop_trace_guarded",
+           "StepWatchdog", "HEARTBEAT_TIMEOUT"]
 
 HEARTBEAT_TIMEOUT = 300  # seconds, matching distributed.py:36
 
+_PROFILER_TIMEOUT = 60  # seconds before declaring the profiler RPC hung
 
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Profile the enclosed steps into ``log_dir`` (TensorBoard format)."""
+
+def _call_with_timeout(fn, timeout: float, what: str,
+                       on_late_completion=None) -> bool:
+    """Run ``fn`` on a watchdog thread; False if it didn't return in time.
+
+    A hung C call can't be cancelled — the thread is daemonic and leaks,
+    which is the acceptable cost of the RUN not hanging (the round-4
+    alternative was a dead 600 s capture window).  If the leaked call
+    COMPLETES later, ``on_late_completion`` runs on that thread — e.g. a
+    start_trace that eventually succeeded after being declared hung must
+    be stopped, or the profiler would silently accumulate events for the
+    rest of the process."""
+    done = threading.Event()
+    err: list[BaseException] = []
+    lock = threading.Lock()
+    state = {"late": False}
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # re-raised on the caller thread
+            err.append(e)
+        with lock:
+            done.set()
+            late = state["late"]
+        if late and not err and on_late_completion is not None:
+            try:
+                on_late_completion()
+            except Exception:
+                pass
+
+    t = threading.Thread(target=run, daemon=True, name=f"profiler-{what}")
+    t.start()
+    if not done.wait(timeout):
+        with lock:
+            if not done.is_set():
+                state["late"] = True
+                make_logger("profiler").warning(
+                    f"jax.profiler {what} did not return within "
+                    f"{timeout:.0f}s — tunneled backends hang here; "
+                    "continuing UNTRACED.  Use the fwd/fwdbwd wall-clock "
+                    "probes (bench.py, docs/MFU_ANALYSIS.md) for "
+                    "attribution on this setup.")
+                return False
+        # completed inside the race window: fall through as a normal return
+    if err:
+        raise err[0]
+    return True
+
+
+def start_trace_guarded(log_dir: str,
+                        timeout: float = _PROFILER_TIMEOUT) -> bool:
+    """Tunnel-safe ``jax.profiler.start_trace``; False = hung/failed, the
+    caller must skip the matching stop."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    def undo_late_start():
+        # the hung start eventually succeeded after we gave up on it:
+        # stop immediately (on the leaked thread) so the profiler doesn't
+        # accumulate events for the rest of the process
+        make_logger("profiler").warning(
+            "hung start_trace completed late; stopping the trace")
+        jax.profiler.stop_trace()
+
+    try:
+        return _call_with_timeout(
+            lambda: jax.profiler.start_trace(log_dir), timeout, "start",
+            on_late_completion=undo_late_start)
+    except Exception as e:
+        make_logger("profiler").warning(f"start_trace failed: {e}")
+        return False
+
+
+def stop_trace_guarded(timeout: float = _PROFILER_TIMEOUT) -> bool:
+    """Tunnel-safe ``jax.profiler.stop_trace``."""
+    import jax
+
+    try:
+        return _call_with_timeout(
+            lambda: jax.profiler.stop_trace(), timeout, "stop")
+    except Exception as e:
+        make_logger("profiler").warning(f"stop_trace failed: {e}")
+        return False
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, timeout: float = _PROFILER_TIMEOUT):
+    """Profile the enclosed steps into ``log_dir`` (TensorBoard format).
+
+    Degrades to a no-op (with a loud warning) when the profiler RPC
+    hangs — see the module docstring's tunnel caveat."""
+    started = start_trace_guarded(log_dir, timeout)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            stop_trace_guarded(timeout)
 
 
 class StepWatchdog:

@@ -1,0 +1,119 @@
+"""The flagship ImageNet entry path, end-to-end on real JPEGs.
+
+Every other CLI e2e test drives ``--dataset synthetic``;
+StreamingImageFolder and the native decoder were only tested in
+isolation.  This glues the whole seam together — ``main()`` →
+StreamingImageFolder → native/PIL decode → train → validate →
+checkpoint → resume — exactly where shape/dtype/sampler-fast-forward
+bugs live (≙ the reference's ImageFolder path, gossip_sgd.py:539-583).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLI_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+WORLD, BATCH, CLASSES, IMG_SRC, IMG = 8, 4, 4, 24, 16
+
+# class -> solid RGB so a TinyCNN separates them within two epochs
+COLORS = [(220, 40, 40), (40, 220, 40), (40, 40, 220), (220, 220, 40)]
+
+
+@pytest.fixture(scope="module")
+def jpeg_root(tmp_path_factory):
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("imagefolder")
+    rng = np.random.default_rng(0)
+    for split, per_class in (("train", 16), ("val", 8)):
+        for c, color in enumerate(COLORS):
+            d = root / split / f"class_{c}"
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                px = np.clip(
+                    np.asarray(color, np.int16)
+                    + rng.integers(-30, 30, (IMG_SRC, IMG_SRC, 3)),
+                    0, 255).astype(np.uint8)
+                Image.fromarray(px).save(d / f"img_{i}.jpg", quality=90)
+    return root
+
+
+def _run(jpeg_root, ckpt_dir, epochs, resume=False, extra=()):
+    cmd = [sys.executable, "-m",
+           "stochastic_gradient_push_tpu.run.gossip_sgd",
+           "--dataset", "imagefolder", "--dataset_dir", str(jpeg_root),
+           "--data_backend", "auto", "--world_size", str(WORLD),
+           "--model", "tiny_cnn", "--num_classes", str(CLASSES),
+           "--image_size", str(IMG), "--batch_size", str(BATCH),
+           "--num_epochs", str(epochs), "--num_itr_ignore", "0",
+           "--num_dataloader_workers", "2", "--lr", "0.05",
+           "--resume", str(resume),
+           "--checkpoint_dir", str(ckpt_dir) + "/", *extra]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600, env=CLI_ENV)
+
+
+def _csv_epoch_rows(csv_path):
+    """(epoch, itr, top1_val) training rows from the reference-schema CSV."""
+    rows = []
+    for ln in csv_path.read_text().splitlines():
+        parts = ln.split(",")
+        if len(parts) > 10 and parts[0].isdigit():
+            rows.append((int(parts[0]), int(parts[1]), float(parts[-1])))
+    return rows
+
+
+@pytest.mark.slow
+def test_imagefolder_cli_end_to_end_with_resume(jpeg_root, tmp_path):
+    r = _run(jpeg_root, tmp_path, epochs=2)
+    assert r.returncode == 0, (r.stderr or r.stdout)[-3000:]
+
+    csv = tmp_path / "out_r0_n8.csv"
+    assert csv.exists(), "reference-schema CSV missing"
+    rows = _csv_epoch_rows(csv)
+    train_rows = [r for r in rows if r[1] >= 0]
+    val_rows = [r for r in rows if r[1] == -1]  # val rows log itr = -1
+    # 64 train images / (8 ranks * batch 4) = 2 iterations per epoch
+    assert {e for e, _, _ in train_rows} == {0, 1}
+    assert all(i < 2 for _, i, _ in train_rows)
+
+    # validation ran each epoch and produced a sane top-1: the 4
+    # solid-color classes are separable, so two epochs beat random (25 %)
+    assert [e for e, _, _ in val_rows] == [0, 1]
+    assert 25.0 <= val_rows[-1][2] <= 100.0, val_rows
+
+    ckpt = tmp_path / "checkpoint_r0_n8.ckpt"
+    assert ckpt.exists()
+
+    # resume for a third epoch: picks up at epoch 2, extends the SAME csv
+    # with exactly one epoch's rows (2 train + 1 val)
+    r2 = _run(jpeg_root, tmp_path, epochs=3, resume=True)
+    assert r2.returncode == 0, (r2.stderr or r2.stdout)[-3000:]
+    assert "resumed from epoch 2" in r2.stdout + r2.stderr
+    rows2 = _csv_epoch_rows(csv)
+    assert {e for e, _, _ in rows2} == {0, 1, 2}
+    assert len(rows2) == len(rows) + 3
+    assert 25.0 <= [r for r in rows2 if r[1] == -1][-1][2] <= 100.0
+
+
+@pytest.mark.slow
+def test_imagefolder_cli_uint8_output_path(jpeg_root, tmp_path):
+    """--data_output uint8 ships raw pixels; the step normalizes on
+    device (train/step.py _device_normalize) — same seam, quantized."""
+    r = _run(jpeg_root, tmp_path, epochs=1,
+             extra=("--data_output", "uint8"))
+    assert r.returncode == 0, (r.stderr or r.stdout)[-3000:]
+    assert (tmp_path / "out_r0_n8.csv").exists()
+    out = r.stdout + r.stderr
+    assert "Prec@1" in out and "done:" in out

@@ -193,3 +193,45 @@ def test_mpi_env_multihost_autodetect(monkeypatch):
     assert not _multihost_env()
     monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
     assert _multihost_env()
+
+
+def test_profiler_guard_times_out_without_hanging():
+    """The tunnel-safe profiler guard (utils/profiling.py): a hung
+    profiler call must return False within the timeout instead of
+    stalling the run (round-4's capture lost 600s to exactly this)."""
+    import time
+
+    from stochastic_gradient_push_tpu.utils.profiling import (
+        _call_with_timeout)
+
+    t0 = time.monotonic()
+    ok = _call_with_timeout(lambda: time.sleep(30), timeout=0.2,
+                            what="test")
+    assert not ok
+    assert time.monotonic() - t0 < 5
+
+    # a fast call passes through, and its exception surfaces
+    assert _call_with_timeout(lambda: None, timeout=5, what="test")
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        _call_with_timeout(
+            lambda: (_ for _ in ()).throw(RuntimeError("x")),
+            timeout=5, what="test")
+
+
+def test_profiler_guard_late_completion_callback():
+    """A call declared hung that later completes must trigger the
+    compensating callback (e.g. stopping a late-started trace)."""
+    import threading
+    import time
+
+    from stochastic_gradient_push_tpu.utils.profiling import (
+        _call_with_timeout)
+
+    compensated = threading.Event()
+    ok = _call_with_timeout(lambda: time.sleep(0.5), timeout=0.1,
+                            what="test",
+                            on_late_completion=compensated.set)
+    assert not ok
+    assert compensated.wait(5), "late completion never compensated"
