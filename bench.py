@@ -71,7 +71,10 @@ PEAK_BF16_TFLOPS = (
 
 _CHILD_START = time.monotonic()
 
-BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))  # flagship config:
+# the BASELINE.md batch sweep picked 128 (re-confirmed round 5: 2602 at
+# b128 vs 2409 b192 / 2563 b256); the driver's plain `python bench.py`
+# must measure THAT config, and the cached-capture fallback matches it
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 # at least one warmup call (compile) and one timed step, whatever the env says
 WARMUP = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
@@ -143,7 +146,14 @@ def run_measurement() -> dict:
     schedule = build_schedule(graph)
     alg = sgp(schedule, GOSSIP_AXIS)
     tx = sgd(momentum=0.9, weight_decay=1e-4, nesterov=True)
-    lr_sched = LRSchedule(ref_lr=0.1, batch_size=BATCH, world_size=world,
+    # "folded" freezes every BN to its running stats — an ATTRIBUTION
+    # probe (docs/MFU_ANALYSIS.md): the step-time delta vs "bn" measures
+    # the BN reduction passes.  An unnormalized ResNet-50 is not
+    # trainable, so run it at lr=0 (identical compute per step; params
+    # stay at init, keeping the loss finite for the validity guard)
+    attribution_only = norm_variant == "folded"
+    lr_sched = LRSchedule(ref_lr=0.0 if attribution_only else 0.1,
+                          batch_size=BATCH, world_size=world,
                           warmup=True)
     step = build_train_step(model, alg, tx, lr_sched, itr_per_epoch=1000,
                             num_classes=1000)
@@ -230,6 +240,7 @@ def run_measurement() -> dict:
         "batch": BATCH,
         **({"stem_s2d": True} if stem_s2d else {}),
         **({"norm": norm_variant} if norm_variant != "bn" else {}),
+        **({"attribution_only": True} if attribution_only else {}),
         "platform": platform,
         "device": device_kind,
         "step_ms": round(time_per_itr * 1e3, 3),
@@ -446,7 +457,17 @@ def _latest_tpu_capture(root: str | None = None) -> dict | None:
     one round's window) is REFUSED: a prior round's number must fail
     loud rather than silently survive into this round's artifact
     (round-4 verdict, weakness #1).
+
+    A record is only eligible when its recorded MODEL-VARIANT config
+    (norm variant, s2d stem — fields the measurement stamps itself)
+    matches the CURRENT run's: a variant capture must never be served
+    as the answer to a different question.  batch/scan are NOT matched
+    (the record carries its own, visible to the consumer): the driver's
+    plain `python bench.py` asks for the headline, and the headline
+    capture's batch is the flagship sweep winner either way.
     """
+    want = {"norm": os.environ.get("BENCH_NORM", "bn"),
+            "stem_s2d": os.environ.get("BENCH_S2D", "0") == "1"}
     if root is None:
         root = os.environ.get("BENCH_TPU_RUNS_DIR") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "docs", "tpu_runs")
@@ -473,8 +494,10 @@ def _latest_tpu_capture(root: str | None = None) -> dict | None:
             # never re-cache a cached line: each fallback must trace to a
             # LIVE on-chip measurement, not compound staleness round over
             # round
+            rec_cfg = {"norm": rec.get("norm", "bn"),
+                       "stem_s2d": bool(rec.get("stem_s2d", False))}
             if rec.get("platform") == "tpu" and rec.get("value") \
-                    and not rec.get("cached"):
+                    and not rec.get("cached") and rec_cfg == want:
                 age_h = _capture_age_hours(run)
                 if age_h is None or age_h > max_age_h:
                     # stale (or unparseable provenance): fail loud — the
@@ -550,7 +573,10 @@ def main():
         _emit(best)
 
     # better than either: this round's recorded on-chip capture, clearly
-    # labelled cached (last emitted line wins with the consumer)
+    # labelled cached (last emitted line wins with the consumer);
+    # _latest_tpu_capture only serves records whose model-variant config
+    # matches this run's, so a variant run can never inherit a plain-bn
+    # capture (or vice versa)
     cached = _latest_tpu_capture()
     if cached is not None:
         cached["error"] = "; ".join(errors)

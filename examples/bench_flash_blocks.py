@@ -11,25 +11,22 @@ Usage: PYTHONPATH=/root/.axon_site:/root/repo python examples/bench_flash_blocks
 
 import itertools
 import json
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from stochastic_gradient_push_tpu.ops.flash_attention import flash_attention
+from stochastic_gradient_push_tpu.utils.profiling import fenced_ms
 
 STEPS = 10
 
 
 def timed(fn, *args):
-    r = fn(*args)
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t0) / STEPS * 1e3
+    # fenced_ms, NOT bare block_until_ready: over the tunnel the latter
+    # returns at RPC-ack and reported 0.02 ms for a 26 ms kernel
+    # (docs/tpu_runs/20260731T062828_mfu/flashblocks.txt is that garbage)
+    return fenced_ms(fn, *args, steps=STEPS)
 
 
 def sweep(b, h, t, d, causal):

@@ -24,7 +24,6 @@ measurement.  Run on the real chip:
 
 import json
 import os
-import time
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +34,7 @@ from stochastic_gradient_push_tpu.models import (TransformerConfig,
 from stochastic_gradient_push_tpu.ops.flash_attention import (
     default_block, flash_attention)
 from stochastic_gradient_push_tpu.train.lm import lm_loss
+from stochastic_gradient_push_tpu.utils.profiling import fenced_ms
 
 D, L, H, T, B, VOCAB = 768, 12, 12, 1024, 8, 32000
 STEPS = int(os.environ.get("LMBENCH_STEPS", "20"))
@@ -43,13 +43,9 @@ HBM_GBPS = 819.0
 
 
 def timeit(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / STEPS * 1e3
+    # fenced (host readback) timing — bare block_until_ready returns at
+    # RPC-ack over the tunnel and measures dispatch, not compute
+    return fenced_ms(fn, *args, steps=STEPS)
 
 
 def model_ms(n_layers):

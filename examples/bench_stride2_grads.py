@@ -17,13 +17,12 @@ whether a ``downsample_s2d`` model variant is worth building.
 Run on the real chip: ``python examples/bench_stride2_grads.py``.
 """
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from stochastic_gradient_push_tpu.models.resnet import space_to_depth
+from stochastic_gradient_push_tpu.utils.profiling import fenced_ms
 
 BATCH = 128
 # (spatial, C_in, C_out) of the three bottleneck stage-transition 3x3/2
@@ -41,28 +40,24 @@ def s2d_kernel_3x3(k3: jnp.ndarray) -> jnp.ndarray:
 
 
 def conv_orig(x, k):
+    # pure-bf16 conv, as the model's flax convs run it; a float32
+    # preferred_element_type here breaks the VJP (the transpose conv
+    # gets an fp32 cotangent against the bf16 kernel and
+    # conv_general_dilated requires matching dtypes)
     return jax.lax.conv_general_dilated(
         x, k, window_strides=(2, 2), padding=[(1, 1), (1, 1)],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def conv_s2d(x, k2):
     xs = space_to_depth(x, 2)
     return jax.lax.conv_general_dilated(
         xs, k2, window_strides=(1, 1), padding=[(1, 0), (1, 0)],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def timeit(fn, *args, steps=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps * 1e3
+    return fenced_ms(fn, *args, steps=steps)
 
 
 def main():
@@ -76,9 +71,9 @@ def main():
               * 0.05).astype(jnp.bfloat16)
         k2 = s2d_kernel_3x3(k3)
 
-        # equivalence check (fp32 accumulate; bf16 inputs)
-        y0 = np.asarray(conv_orig(x, k3))
-        y1 = np.asarray(conv_s2d(x, k2))
+        # equivalence check (bf16 conv outputs compared in fp32)
+        y0 = np.asarray(conv_orig(x, k3), np.float32)
+        y1 = np.asarray(conv_s2d(x, k2), np.float32)
         err = float(np.max(np.abs(y0 - y1)) / (np.max(np.abs(y0)) + 1e-9))
         assert err < 5e-2, (
             f"s2d formulation diverged (rel_err {err:.3e}) — timings "

@@ -16,16 +16,9 @@ case "$KIND" in
 esac
 echo "chip: $KIND" | tee "$OUT/chip.txt"
 
-echo "== norm-variant retries (long compile budget) =="
-# the first tpu_r5_mfu pass gave each variant 600 s; fresh-program
-# remote compiles need more.  Re-run with real budgets; second attempts
-# may also hit the remote compile cache from the first pass.
-for NV in folded bn16; do
-  BENCH_NORM=$NV BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=1 \
-  BENCH_TIMEOUT=1000 BENCH_DEADLINE=1100 \
-    timeout 1200 python bench.py 2>>"$OUT/norm_retry.err" \
-    | tail -1 | tee -a "$OUT/norm_retry.jsonl"
-done
+# norm-variant retries moved to tpu_r5_retry.sh: bn16 landed in the
+# first tpu_r5_mfu pass (48.50 ms vs bn 49.22) and folded needed the
+# lr=0 attribution fix in bench.py
 
 echo "== LM phase decomposition (d768/L12/t1024/b8) =="
 timeout 1200 python examples/bench_lm_phases.py \

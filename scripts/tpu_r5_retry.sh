@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 retry batch: the probes whose first pass was invalid —
+#   1. stride-2 grads (dtype bug: fp32 preferred_element_type broke VJP)
+#   2. flash (bq,bk) sweep (bare block_until_ready measured RPC-ack,
+#      not compute — now host-readback fenced via profiling.fenced_ms)
+#   3. folded norm variant (NaN: unnormalized net not trainable; now an
+#      lr=0 attribution probe)
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+OUT="${OUT:-$REPO/docs/tpu_runs/$(date -u +%Y%m%dT%H%M%S)_retry}"
+mkdir -p "$OUT"
+cd "$REPO"
+
+KIND=$(timeout 75 python -c "import jax; print(jax.devices()[0].device_kind)" 2>/dev/null)
+case "$KIND" in
+  *[Cc]pu*|"") echo "tunnel down ('$KIND'); aborting" | tee "$OUT/ABORTED"; exit 1;;
+esac
+echo "chip: $KIND" | tee "$OUT/chip.txt"
+
+echo "== stride-2 input-grad layout probe (fixed) =="
+timeout 900 python examples/bench_stride2_grads.py \
+  > "$OUT/stride2.txt" 2>"$OUT/stride2.err"
+tail -5 "$OUT/stride2.txt"
+
+echo "== folded norm attribution probe (lr=0) =="
+BENCH_NORM=folded BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=0 BENCH_PHASES=1 \
+BENCH_TIMEOUT=1000 BENCH_DEADLINE=1100 \
+  timeout 1200 python bench.py 2>"$OUT/folded.err" \
+  | tail -1 | tee "$OUT/folded.jsonl"
+
+echo "== flash asymmetric (bq,bk) sweep (fenced) =="
+timeout 1800 python examples/bench_flash_blocks.py \
+  > "$OUT/flashblocks.txt" 2>"$OUT/flashblocks.err"
+tail -6 "$OUT/flashblocks.txt"
+
+echo "== done: $OUT =="
+ls -la "$OUT"

@@ -32,7 +32,7 @@ import time
 from .logging import make_logger
 
 __all__ = ["trace", "start_trace_guarded", "stop_trace_guarded",
-           "StepWatchdog", "HEARTBEAT_TIMEOUT"]
+           "StepWatchdog", "HEARTBEAT_TIMEOUT", "fenced_ms"]
 
 HEARTBEAT_TIMEOUT = 300  # seconds, matching distributed.py:36
 
@@ -135,6 +135,39 @@ def trace(log_dir: str, timeout: float = _PROFILER_TIMEOUT):
     finally:
         if started:
             stop_trace_guarded(timeout)
+
+
+def fenced_ms(fn, *args, steps: int = 10, warmup: int = 1) -> float:
+    """Amortized wall-clock milliseconds per call of ``fn(*args)``,
+    fenced by a HOST READBACK of the result.
+
+    ``jax.block_until_ready`` alone is NOT a completion fence on a
+    tunneled/remote backend — it can return at RPC-ack time, which made
+    one probe report 0.02 ms for a 26 ms attention kernel (and, earlier,
+    a 410 % MFU).  The only trusted fence is materializing bytes that
+    depend on the computation on the host (same discipline as
+    bench.py's ``fence``).  The readback slices the first output leaf
+    down to ONE element on-device (a data-dependent gather) and pulls
+    only that scalar, so the fence costs a 2-byte transfer, not a
+    full-tensor tunnel copy inside the timed region.
+    """
+    import jax as _jax
+    import numpy as _np
+
+    def _fence(r):
+        leaf = _jax.tree_util.tree_leaves(r)[0]
+        nd = getattr(leaf, "ndim", 0)
+        _np.asarray(_jax.device_get(leaf[(0,) * nd] if nd else leaf))
+
+    r = None
+    for _ in range(max(1, warmup)):
+        r = fn(*args)
+    _fence(r)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        r = fn(*args)
+    _fence(r)
+    return (time.perf_counter() - t0) / steps * 1e3
 
 
 class StepWatchdog:

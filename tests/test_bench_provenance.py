@@ -16,6 +16,14 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True)
+def _default_variant_env(monkeypatch):
+    """_latest_tpu_capture matches on BENCH_NORM/BENCH_S2D; a stray
+    export in the invoking shell must not flip these tests' config."""
+    monkeypatch.delenv("BENCH_NORM", raising=False)
+    monkeypatch.delenv("BENCH_S2D", raising=False)
+
+
 @pytest.fixture()
 def bench_mod():
     spec = importlib.util.spec_from_file_location(
@@ -70,6 +78,38 @@ def test_cached_lines_never_recached(bench_mod, tmp_path):
     _write_capture(tmp_path, run, dict(LIVE_REC, cached=True,
                                        cached_from="docs/tpu_runs/old"))
     assert bench_mod._latest_tpu_capture(root=str(tmp_path)) is None
+
+
+def test_variant_capture_never_crosses_config(bench_mod, tmp_path,
+                                              monkeypatch):
+    """A cached record is only served to a run whose model-variant
+    config (norm / s2d stem) matches the record's own stamped fields —
+    a folded/s2d capture must not answer a default-config run, nor the
+    reverse."""
+    import datetime as dt
+
+    run = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%dT%H%M%S")
+    _write_capture(tmp_path, run, dict(LIVE_REC, norm="folded"))
+    monkeypatch.delenv("BENCH_NORM", raising=False)
+    monkeypatch.delenv("BENCH_S2D", raising=False)
+    # default run must refuse the folded capture
+    assert bench_mod._latest_tpu_capture(root=str(tmp_path)) is None
+    # the matching variant run gets it
+    monkeypatch.setenv("BENCH_NORM", "folded")
+    rec = bench_mod._latest_tpu_capture(root=str(tmp_path))
+    assert rec is not None and rec["norm"] == "folded"
+    # an s2d run must refuse it too (wrong variant)
+    monkeypatch.setenv("BENCH_NORM", "bn")
+    monkeypatch.setenv("BENCH_S2D", "1")
+    assert bench_mod._latest_tpu_capture(root=str(tmp_path)) is None
+    # a plain-bn capture (older, still fresh) answers the default run:
+    # the non-matching folded run is skipped over, not fatal
+    older = (dt.datetime.now(dt.timezone.utc)
+             - dt.timedelta(minutes=1)).strftime("%Y%m%dT%H%M%S")
+    _write_capture(tmp_path, older, LIVE_REC)
+    monkeypatch.setenv("BENCH_S2D", "0")
+    rec = bench_mod._latest_tpu_capture(root=str(tmp_path))
+    assert rec is not None and rec.get("norm") is None
 
 
 def test_age_override_env(bench_mod, tmp_path, monkeypatch):
