@@ -33,6 +33,9 @@ from stochastic_gradient_push_tpu.train.lm import (build_lm_train_step,
 
 STEPS = int(os.environ.get("LMBENCH_STEPS", "20"))
 SCAN = int(os.environ.get("LMBENCH_SCAN", "4"))
+# override the flash/blockwise attention block size (None = the
+# default_block auto rule) — the t1024 block A/B for docs/LM_MFU.md
+BLOCK = int(os.environ.get("LMBENCH_BLOCK", "0")) or None
 
 # (d_model, n_layers, n_heads, seq_len, batch) — a ~125M GPT-small-shaped
 # config and a long-context variant
@@ -67,7 +70,7 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000,
         vocab_size=vocab, d_model=d_model, n_layers=n_layers,
         n_heads=n_heads, d_ff=4 * d_model, max_len=seq,
         dtype=jnp.bfloat16, attn_impl=attn,
-        moe_experts=moe_experts)
+        attn_block_size=BLOCK, moe_experts=moe_experts)
     model = TransformerLM(cfg)
     alg = sgp(build_schedule(NPeerDynamicDirectedExponentialGraph(
         world, peers_per_itr=1) if world > 1 else
@@ -140,7 +143,8 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000,
         jax.tree.map(lambda a: a[0], state.params)))
     tokens_per_sec = world * batch * seq / time_per_itr
     out = {"config": f"d{d_model} L{n_layers} h{n_heads} t{seq} b{batch}",
-           "attn": attn, "moe_experts": moe_experts,
+           "attn": attn, **({"block": BLOCK} if BLOCK else {}),
+           "moe_experts": moe_experts,
            "params_m": round(n_params / 1e6, 1), "scan": SCAN,
            "tokens_per_sec_per_chip": round(tokens_per_sec / world),
            "step_ms": round(time_per_itr * 1e3, 2), "loss": round(loss, 3)}

@@ -1,0 +1,47 @@
+#!/bin/bash
+# LM t1024 attention A/B (docs/LM_MFU.md): the scanned, amortized full
+# train step is the only tunnel-trustworthy timing, so decide the
+# t1024 block size (and flash-vs-XLA-full) at the step level:
+#   flash block auto(=128) | 256 | 512, then attn_impl=full
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+OUT="${OUT:-$REPO/docs/tpu_runs/$(date -u +%Y%m%dT%H%M%S)_lmblock}"
+mkdir -p "$OUT"
+cd "$REPO"
+
+KIND=$(timeout 75 python -c "import jax; print(jax.devices()[0].device_kind)" 2>/dev/null)
+case "$KIND" in
+  *[Cc]pu*|"") echo "tunnel down ('$KIND'); aborting" | tee "$OUT/ABORTED"; exit 1;;
+esac
+echo "chip: $KIND" | tee "$OUT/chip.txt"
+
+CFG="768,12,12,1024,8"
+for BLK in 0 256 512; do
+  echo "== flash t1024 block=$BLK =="
+  LMBENCH_CONFIGS="$CFG" LMBENCH_BLOCK=$BLK \
+    timeout 900 python - <<'EOF' 2>>"$OUT/lmblock.err" | tee -a "$OUT/lmblock.jsonl"
+import examples.bench_lm_tpu as m
+for cfg in m.parse_configs():
+    m.run(*cfg, attn="flash")
+EOF
+done
+
+echo "== full (XLA) t1024 =="
+LMBENCH_CONFIGS="$CFG" \
+  timeout 900 python - <<'EOF' 2>>"$OUT/lmblock.err" | tee -a "$OUT/lmblock.jsonl"
+import examples.bench_lm_tpu as m
+for cfg in m.parse_configs():
+    m.run(*cfg, attn="full")
+EOF
+
+echo "== t2048 block cross-check (flash 256) =="
+LMBENCH_CONFIGS="768,12,12,2048,4" LMBENCH_BLOCK=256 \
+  timeout 900 python - <<'EOF' 2>>"$OUT/lmblock.err" | tee -a "$OUT/lmblock.jsonl"
+import examples.bench_lm_tpu as m
+for cfg in m.parse_configs():
+    m.run(*cfg, attn="flash")
+EOF
+
+echo "== done: $OUT =="
+ls -la "$OUT"
