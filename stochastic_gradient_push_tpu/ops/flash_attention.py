@@ -63,12 +63,20 @@ _STATE_LANES = 128
 
 
 def default_block(t: int) -> int:
-    """Measured auto block size (docs/FLASH_TPU_RESULTS.txt, TPU v5e):
-    512 wins decisively from t=2048 up (bwd 23.5 vs 28.6 ms at t2048,
-    46.4 vs 70.3 at t4096); at t<=1024 the 128 default is best measured.
+    """Measured auto block size (TPU v5e): the LARGEST block that tiles
+    the sequence wins at every measured length.  Step-level A/B on the
+    full d768/L12 LM train step (scanned+fenced, the only timing that is
+    trustworthy over the tunneled dev chip —
+    docs/tpu_runs/20260731T072937_lmblock): at t=1024 block 512 runs the
+    step at 64.0 ms vs 82.7 (block 256) vs 127.5 (block 128) — 2.0x —
+    and block 512 also wins the kernel-level fenced sweeps at t=2048 and
+    t=4096 (docs/tpu_runs/20260731T071733_retry/flashblocks.txt).  An
+    earlier round's "128 best at t<=1024" rule came from UNFENCED
+    micro-benchmarks that measured RPC-ack latency, not compute.
     The 3-D-grid schedule keeps VMEM at O(block^2), so 512 is safe."""
-    if t >= 2048 and t % 512 == 0:
-        return 512
+    for b in (512, 256, 128):
+        if t % b == 0:
+            return b
     return min(128, t)
 
 

@@ -71,11 +71,14 @@ def test_default_block_rule():
     from stochastic_gradient_push_tpu.ops.flash_attention import (
         default_block)
 
+    # largest tiling block wins at every measured length (the round-5
+    # step-level A/B: t1024 block 512 is 2.0x block 128)
     assert default_block(64) == 64
-    assert default_block(1024) == 128
+    assert default_block(1024) == 512
     assert default_block(2048) == 512
     assert default_block(4096) == 512
-    assert default_block(2048 + 128) == 128  # not divisible by 512
+    assert default_block(1024 + 256) == 256  # not divisible by 512
+    assert default_block(2048 + 128) == 128  # only 128 tiles it
 
 
 @pytest.mark.parametrize("block_q,block_k", [(16, 32), (32, 16)])
