@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 end-of-window insurance + follow-ups:
+#   1. a FRESH full-headline bench.jsonl (AR delta + phase probes) so the
+#      driver's end-of-round bench has a <12h-old capture to fall back on
+#      if the tunnel is dead at that moment (_latest_tpu_capture reads
+#      docs/tpu_runs/<ts>/bench.jsonl only)
+#   2. MoE t1024 at the NEW auto block (was 51.3k tok/s / 30.6% at blk128)
+#   3. LM headline line at the new rule for the record (t1024 auto)
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="/root/.axon_site:$REPO${PYTHONPATH:+:$PYTHONPATH}"
+OUT="${OUT:-$REPO/docs/tpu_runs/$(date -u +%Y%m%dT%H%M%S)}"
+mkdir -p "$OUT"
+cd "$REPO"
+
+KIND=$(timeout 75 python -c "import jax; print(jax.devices()[0].device_kind)" 2>/dev/null)
+case "$KIND" in
+  *[Cc]pu*|"") echo "tunnel down ('$KIND'); aborting" | tee "$OUT/ABORTED"; exit 1;;
+esac
+echo "chip: $KIND" | tee "$OUT/chip.txt"
+
+echo "== full headline bench (AR + phases) =="
+BENCH_BATCH=128 BENCH_SCAN=5 BENCH_AR=1 BENCH_PHASES=1 \
+BENCH_TIMEOUT=1000 BENCH_DEADLINE=1100 \
+  timeout 1200 python bench.py 2>"$OUT/bench.err" \
+  | tail -1 | tee "$OUT/bench.jsonl"
+
+echo "== LM at the new auto block (t1024 flagship + MoE) =="
+LMBENCH_CONFIGS="768,12,12,1024,8" \
+  timeout 1500 python - <<'EOF' 2>>"$OUT/lm.err" | tee -a "$OUT/lm.txt"
+import examples.bench_lm_tpu as m
+for cfg in m.parse_configs():
+    m.run(*cfg, attn="flash")
+m.run(768, 12, 12, 1024, 8, attn="flash", moe_experts=8)
+EOF
+
+echo "== done: $OUT =="
+ls -la "$OUT"

@@ -12,8 +12,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   KIND=$(timeout 75 python -c "import jax; d=jax.devices(); print(d[0].device_kind, len(d))" 2>/dev/null)
   case "$KIND" in
     *[Cc]pu*|"") echo "[$(date -u +%H:%M:%S)] probe $N: tunnel down ('$KIND')";;
-    *) echo "[$(date -u +%H:%M:%S)] probe $N: ALIVE: $KIND — firing tpu_window.sh"
-       bash "$REPO/scripts/tpu_window.sh"
+    *) echo "[$(date -u +%H:%M:%S)] probe $N: ALIVE: $KIND — firing tpu_r5_insurance.sh"
+       bash "$REPO/scripts/tpu_r5_insurance.sh"
        exit $? ;;
   esac
   sleep 240

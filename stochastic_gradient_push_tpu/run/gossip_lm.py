@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "ring_flash"],
                    help="default: ring when --sp > 1 else flash on TPU, "
                         "full elsewhere")
+    p.add_argument("--attn_block", default=0, type=int,
+                   help="flash/blockwise/ring_flash block size override "
+                        "(0 = the measured auto rule, "
+                        "ops.flash_attention.default_block)")
     p.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
     p.add_argument("--remat", default="False", type=str)
     p.add_argument("--grad_accum", default=1, type=int,
@@ -367,6 +371,7 @@ def main(argv=None):
         max_len=args.seq_len,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         attn_impl=attn, seq_axis=SEQ_AXIS if ring_family else None,
+        attn_block_size=args.attn_block or None,
         remat=sb(args.remat),
         moe_experts=args.moe_experts, moe_every=args.moe_every,
         ep_axis=EP_AXIS if ep > 1 else None)

@@ -24,6 +24,15 @@ def _default_variant_env(monkeypatch):
     monkeypatch.delenv("BENCH_S2D", raising=False)
 
 
+@pytest.fixture(autouse=True)
+def _no_round_marker(request, monkeypatch):
+    """Pin the git round-marker lookup to 'unavailable' so the age rule
+    is what these tests exercise; the round-marker test overrides."""
+    if "bench_mod" in getattr(request, "fixturenames", ()):
+        mod = request.getfixturevalue("bench_mod")
+        monkeypatch.setattr(mod, "_round_start_epoch", lambda: None)
+
+
 @pytest.fixture()
 def bench_mod():
     spec = importlib.util.spec_from_file_location(
@@ -110,6 +119,39 @@ def test_variant_capture_never_crosses_config(bench_mod, tmp_path,
     monkeypatch.setenv("BENCH_S2D", "0")
     rec = bench_mod._latest_tpu_capture(root=str(tmp_path))
     assert rec is not None and rec.get("norm") is None
+
+
+def test_round_marker_overrides_age(bench_mod, tmp_path, monkeypatch):
+    """A capture past the age limit but newer than the round marker is
+    still this round's — served (age-stamped).  Past 2x the limit, or
+    older than the marker, it stays refused."""
+    import datetime as dt
+
+    old = (dt.datetime.now(dt.timezone.utc)
+           - dt.timedelta(hours=14)).strftime("%Y%m%dT%H%M%S")
+    _write_capture(tmp_path, old, LIVE_REC)
+    cap_epoch = bench_mod._capture_epoch(old)
+    # marker BEFORE the capture -> this round's -> served despite 14h
+    monkeypatch.setattr(bench_mod, "_round_start_epoch",
+                        lambda: cap_epoch - 3600)
+    rec = bench_mod._latest_tpu_capture(root=str(tmp_path))
+    assert rec is not None and 13.9 < rec["capture_age_h"] < 14.1
+    # marker AFTER the capture -> prior round's -> refused
+    monkeypatch.setattr(bench_mod, "_round_start_epoch",
+                        lambda: cap_epoch + 3600)
+    assert bench_mod._latest_tpu_capture(root=str(tmp_path)) is None
+    # no marker available -> pure age rule -> refused
+    monkeypatch.setattr(bench_mod, "_round_start_epoch", lambda: None)
+    assert bench_mod._latest_tpu_capture(root=str(tmp_path)) is None
+    # beyond the 2x backstop the marker cannot save it
+    ancient = (dt.datetime.now(dt.timezone.utc)
+               - dt.timedelta(hours=25)).strftime("%Y%m%dT%H%M%S")
+    tmp2 = tmp_path / "b"
+    _write_capture(tmp2, ancient, LIVE_REC)
+    monkeypatch.setattr(
+        bench_mod, "_round_start_epoch",
+        lambda: bench_mod._capture_epoch(ancient) - 3600)
+    assert bench_mod._latest_tpu_capture(root=str(tmp2)) is None
 
 
 def test_age_override_env(bench_mod, tmp_path, monkeypatch):
