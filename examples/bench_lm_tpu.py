@@ -36,6 +36,8 @@ SCAN = int(os.environ.get("LMBENCH_SCAN", "4"))
 # override the flash/blockwise attention block size (None = the
 # default_block auto rule) — the t1024 block A/B for docs/LM_MFU.md
 BLOCK = int(os.environ.get("LMBENCH_BLOCK", "0")) or None
+# flash K/V-side block override (None = symmetric with BLOCK)
+BLOCK_K = int(os.environ.get("LMBENCH_BLOCK_K", "0")) or None
 
 # (d_model, n_layers, n_heads, seq_len, batch) — a ~125M GPT-small-shaped
 # config and a long-context variant
@@ -70,7 +72,8 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000,
         vocab_size=vocab, d_model=d_model, n_layers=n_layers,
         n_heads=n_heads, d_ff=4 * d_model, max_len=seq,
         dtype=jnp.bfloat16, attn_impl=attn,
-        attn_block_size=BLOCK, moe_experts=moe_experts)
+        attn_block_size=BLOCK, attn_block_k=BLOCK_K,
+        moe_experts=moe_experts)
     model = TransformerLM(cfg)
     alg = sgp(build_schedule(NPeerDynamicDirectedExponentialGraph(
         world, peers_per_itr=1) if world > 1 else
@@ -144,6 +147,7 @@ def run(d_model, n_layers, n_heads, seq, batch, vocab=32000,
     tokens_per_sec = world * batch * seq / time_per_itr
     out = {"config": f"d{d_model} L{n_layers} h{n_heads} t{seq} b{batch}",
            "attn": attn, **({"block": BLOCK} if BLOCK else {}),
+           **({"block_k": BLOCK_K} if BLOCK_K else {}),
            "moe_experts": moe_experts,
            "params_m": round(n_params / 1e6, 1), "scan": SCAN,
            "tokens_per_sec_per_chip": round(tokens_per_sec / world),

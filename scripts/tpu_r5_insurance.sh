@@ -34,5 +34,15 @@ for cfg in m.parse_configs():
 m.run(768, 12, 12, 1024, 8, attn="flash", moe_experts=8)
 EOF
 
+echo "== asymmetric (bq512, bk256) step-level A/B at t1024 =="
+# the fenced kernel sweep's best backward pair; symmetric 512 is the
+# 64.0 ms baseline from 20260731T072937_lmblock
+LMBENCH_CONFIGS="768,12,12,1024,8" LMBENCH_BLOCK=512 LMBENCH_BLOCK_K=256 \
+  timeout 900 python - <<'EOF' 2>>"$OUT/lm.err" | tee -a "$OUT/lm.txt"
+import examples.bench_lm_tpu as m
+for cfg in m.parse_configs():
+    m.run(*cfg, attn="flash")
+EOF
+
 echo "== done: $OUT =="
 ls -la "$OUT"

@@ -60,6 +60,10 @@ class TransformerConfig(tp.NamedTuple):
     # block size for blockwise/flash/ring_flash; None = the measured
     # auto rule (ops.flash_attention.default_block) on the local length
     attn_block_size: int | None = None
+    # flash only: a different K/V-side block (None = attn_block_size).
+    # The fenced kernel sweep found asymmetric (bq 512, bk 256) best for
+    # the t=1024 backward (docs/tpu_runs/20260731T071733_retry)
+    attn_block_k: int | None = None
     seq_axis: str | None = None       # mesh axis for ring attention
     remat: bool = False               # jax.checkpoint each block
     moe_experts: int = 0              # total experts (0 = dense FFN)
@@ -107,9 +111,10 @@ class _Attention(nn.Module):
                 block=cfg.attn_block_size or default_block(q.shape[2]))
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=True,
-                                  block_q=cfg.attn_block_size,
-                                  block_k=cfg.attn_block_size)
+            out = flash_attention(
+                q, k, v, causal=True,
+                block_q=cfg.attn_block_size,
+                block_k=cfg.attn_block_k or cfg.attn_block_size)
         elif cfg.attn_impl == "blockwise":
             out = blockwise_attention(
                 q, k, v, min(cfg.attn_block_size or 128, q.shape[2]),

@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flash/blockwise/ring_flash block size override "
                         "(0 = the measured auto rule, "
                         "ops.flash_attention.default_block)")
+    p.add_argument("--attn_block_k", default=0, type=int,
+                   help="flash only: asymmetric K/V-side block "
+                        "(0 = symmetric with --attn_block)")
     p.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
     p.add_argument("--remat", default="False", type=str)
     p.add_argument("--grad_accum", default=1, type=int,
@@ -372,6 +375,7 @@ def main(argv=None):
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         attn_impl=attn, seq_axis=SEQ_AXIS if ring_family else None,
         attn_block_size=args.attn_block or None,
+        attn_block_k=args.attn_block_k or None,
         remat=sb(args.remat),
         moe_experts=args.moe_experts, moe_every=args.moe_every,
         ep_axis=EP_AXIS if ep > 1 else None)

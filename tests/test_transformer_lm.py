@@ -53,6 +53,14 @@ def test_attention_backends_agree():
         out = other.apply(variables, tokens)
         np.testing.assert_allclose(np.asarray(out_full), np.asarray(out),
                                    rtol=2e-4, atol=2e-4, err_msg=impl)
+    # asymmetric flash blocks (block_k != block_q) are the same function
+    asym_cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=D, n_layers=LAYERS, n_heads=HEADS,
+        d_ff=64, max_len=SEQ, attn_impl="flash", attn_block_size=16,
+        attn_block_k=8)
+    out = TransformerLM(asym_cfg).apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(out),
+                               rtol=2e-4, atol=2e-4, err_msg="flash asym")
 
 
 def test_ring_sequence_parallel_forward_matches_single_device():
