@@ -459,8 +459,11 @@ def _round_start_epoch() -> float | None:
     import subprocess
 
     try:
+        # anchored to the driver's exact subject format ("round N:
+        # VERDICT + ADVICE + BENCH") so an ordinary commit that merely
+        # MENTIONS the phrase mid-line can never move the round boundary
         out = subprocess.run(
-            ["git", "log", "--grep", "VERDICT + ADVICE", "-1",
+            ["git", "log", "--grep", "^round [0-9][0-9]*: VERDICT", "-1",
              "--format=%ct"],
             cwd=os.path.dirname(os.path.abspath(__file__)),
             capture_output=True, text=True, timeout=10)

@@ -34,6 +34,14 @@ WORLD = 8
 STEPS = int(os.environ.get("LM_STUDY_STEPS", "2500"))
 VAL_EVERY = 100
 OUT_DIR = os.environ.get("LM_STUDY_DIR", "/tmp/convergence_lm")
+# model scale knobs (defaults = the headline study; LM_STUDY_SCALE=big
+# runs the 4x-larger dose point recorded in CONVERGENCE_PARITY.md)
+if os.environ.get("LM_STUDY_SCALE") == "big":
+    D_MODEL, N_LAYERS, N_HEADS, D_FF, SEQ = 128, 4, 4, 512, 256
+    FIG = "docs/convergence_lm_big.png"
+else:
+    D_MODEL, N_LAYERS, N_HEADS, D_FF, SEQ = 64, 2, 4, 256, 128
+    FIG = "docs/convergence_lm.png"
 
 # fixed-order categorical palette (validated; see dataviz palette.md)
 PALETTE = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4"]
@@ -49,9 +57,10 @@ CONFIGS = [
     ("AD-PSGD", ["--bilat", "True", "--graph_type", "1"]),
 ]
 
-BASE = ["--world_size", str(WORLD), "--seq_len", "128",
-        "--d_model", "64", "--n_heads", "4", "--n_layers", "2",
-        "--d_ff", "256", "--batch_size", "2",
+BASE = ["--world_size", str(WORLD), "--seq_len", str(SEQ),
+        "--d_model", str(D_MODEL), "--n_heads", str(N_HEADS),
+        "--n_layers", str(N_LAYERS),
+        "--d_ff", str(D_FF), "--batch_size", "2",
         "--num_steps", str(STEPS), "--warmup", "True",
         "--val_frac", "0.1", "--val_every", str(VAL_EVERY),
         "--val_batches", "8", "--print_freq", str(VAL_EVERY),
@@ -121,7 +130,7 @@ def main():
     import matplotlib.pyplot as plt
 
     fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.4), dpi=150)
-    tokens_per_step = WORLD * 2 * 128
+    tokens_per_step = WORLD * 2 * SEQ
     for (name, rows), color in zip(curves.items(), PALETTE):
         m = np.isfinite(rows["val_loss"])
         steps = rows["step"][m]
@@ -140,11 +149,11 @@ def main():
     ax1.legend(frameon=False, fontsize=8, loc="upper right")
     ax1.set_title("LM convergence parity: same token budget")
     ax2.set_title("error vs wall-clock")
-    fig.suptitle("Byte-level LM (0.33M params), real corpus "
+    fig.suptitle(f"Byte-level LM (d{D_MODEL} L{N_LAYERS}), real corpus "
                  "(CPython stdlib), 8-rank mesh", fontsize=10)
     fig.tight_layout()
-    fig.savefig("docs/convergence_lm.png")
-    print("wrote docs/convergence_lm.png", flush=True)
+    fig.savefig(FIG)
+    print(f"wrote {FIG}", flush=True)
 
 
 if __name__ == "__main__":
