@@ -89,11 +89,33 @@ def run_config(name, extra, corpus):
 
     ckpt = os.path.join(OUT_DIR, name.replace(" ", "_"))
     os.makedirs(ckpt, exist_ok=True)
+    csv = os.path.join(ckpt, f"lm_out_n{WORLD}.csv")
+    if os.environ.get("LM_STUDY_REUSE") == "1" and os.path.exists(csv):
+        # reuse a finished arm's CSV (e.g. re-running one arm after a
+        # val-semantics change).  Wall-clock is reconstructed from the
+        # CSV's OWN final step and the run's seq (not the current
+        # STEPS/SEQ globals — a stale CSV from another scale must not be
+        # silently rescaled), using its train-throughput column; note
+        # the CSV's tokens_per_sec excludes compile/validation wall, so
+        # reused arms' wall axis is train-time-only (slightly tighter
+        # than fresh arms' perf_counter wall).
+        rows = np.atleast_1d(np.genfromtxt(csv, delimiter=",",
+                                           names=True))
+        csv_steps = float(rows["step"][-1])
+        if int(csv_steps) != STEPS:
+            raise SystemExit(
+                f"{name}: existing CSV has {int(csv_steps)} steps but "
+                f"LM_STUDY_STEPS={STEPS}; refusing to mix budgets — "
+                "delete the arm's directory to re-run it")
+        tps = float(np.mean(rows["tokens_per_sec"]))
+        wall = csv_steps * WORLD * 2 * SEQ / max(tps, 1.0)
+        print(f"{name}: reusing {csv} (wall reconstructed "
+              f"{wall/60:.1f} min, train-time-only)", flush=True)
+        return rows, wall
     t0 = time.perf_counter()
     gossip_lm.main(BASE + extra + [
         "--corpus_file", corpus, "--checkpoint_dir", ckpt])
     wall = time.perf_counter() - t0
-    csv = os.path.join(ckpt, f"lm_out_n{WORLD}.csv")
     # atleast_1d: a single-row CSV genfromtxts to a 0-d structured array
     rows = np.atleast_1d(np.genfromtxt(csv, delimiter=",", names=True))
     return rows, wall

@@ -169,6 +169,28 @@ class PushSumGossip(GossipAlgorithm):
         w = as_scalar(state.ps_weight)
         return jax.tree.map(lambda p: p / w.astype(p.dtype), params)
 
+    def val_params(self, params, state):
+        """Validation view: drain every in-flight share first (≙ the
+        reference's ``model.eval()`` blocking drain before validation,
+        distributed.py:322-327), then de-bias.  At staleness 1 this
+        makes OSGP validation numerically IDENTICAL to sync SGP — the
+        local+incoming split is exact, so between-step params differ
+        from the synchronous trajectory only by the not-yet-applied
+        incoming share this method adds back.  The training state is
+        untouched (pure eval-time view)."""
+        if not self.overlap:
+            return self.eval_params(params, state)
+        ps_weight = state.ps_weight
+        for in_p, in_w in state.in_flight:
+            params = jax.tree.map(lambda p, b: p + b.astype(p.dtype),
+                                  params, in_p)
+            ps_weight = ps_weight + jnp.reshape(in_w,
+                                                jnp.shape(ps_weight))
+        if not self.track_weight:
+            return params
+        w = as_scalar(ps_weight)
+        return jax.tree.map(lambda p: p / w.astype(p.dtype), params)
+
     def post_step(self, params, state):
         phase = state.phase
         if not self.overlap:

@@ -76,6 +76,15 @@ class GossipAlgorithm:
         (≙ ``unbias``, distributed.py:307-314)."""
         return params
 
+    def val_params(self, params: Params, state: GossipState) -> Params:
+        """Parameters for VALIDATION/metrics.  Like :meth:`eval_params`,
+        but overlap algorithms additionally DRAIN in-flight gossip the
+        way the reference's ``model.eval()`` does before validating
+        (``_query_gossip_queue`` final drain, distributed.py:322-327) —
+        the training trajectory never sees this; it is an eval-time
+        view.  Default: identical to ``eval_params``."""
+        return self.eval_params(params, state)
+
     def reduce_grads(self, grads: Params) -> Params:
         return grads
 

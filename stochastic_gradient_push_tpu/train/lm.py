@@ -462,7 +462,7 @@ def build_lm_eval_step(model, algorithm: GossipAlgorithm,
     independently; only the seq/ep means are collective)."""
 
     def eval_step(state: TrainState, tokens, targets):
-        z = algorithm.eval_params(state.params, state.gossip)
+        z = algorithm.val_params(state.params, state.gossip)
         logits = model.apply({"params": z}, tokens, train=False)
         ce = lm_loss(logits, targets)
         if seq_axis is not None:

@@ -372,7 +372,7 @@ def build_pp_eval_step(model, algorithm: GossipAlgorithm,
     ep_axis = getattr(getattr(model, "cfg", None), "ep_axis", None)
 
     def eval_step(state: TrainState, tokens, targets):
-        z = algorithm.eval_params(state.params, state.gossip)
+        z = algorithm.val_params(state.params, state.gossip)
         S = lax.axis_size(pipe_axis)
         stage = lax.axis_index(pipe_axis)
         hidden = pipeline_hidden(model, z, tokens, pipe_axis)

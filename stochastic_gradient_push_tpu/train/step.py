@@ -180,7 +180,7 @@ def build_eval_step(model, algorithm: GossipAlgorithm,
 
     def eval_step(state: TrainState, images, labels):
         images = _device_normalize(images)
-        z = algorithm.eval_params(state.params, state.gossip)
+        z = algorithm.val_params(state.params, state.gossip)
         logits = model.apply(
             {"params": z, "batch_stats": state.batch_stats},
             images, train=False)

@@ -223,6 +223,50 @@ def test_osgp_one_step_staleness_vs_sync(mesh):
                                np.asarray(p_sync), rtol=1e-5, atol=1e-6)
 
 
+def test_osgp_val_params_drains_to_sync(mesh):
+    """Validation parity with the reference's ``model.eval()`` drain
+    (distributed.py:322-327): at staleness 1 the local+incoming split is
+    exact, so OSGP's TRAINING trajectory as seen by the forward is
+    identical to sync SGP's — and ``val_params`` (which drains the
+    in-flight share before de-biasing) must therefore equal sync SGP's
+    eval view at every step.  ``eval_params`` alone (undrained) must
+    NOT, or the overlap buffer would be vacuous."""
+    graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(graph)
+    lr = 0.05
+    alg_s = sgp(sched, GOSSIP_AXIS)
+    alg_o = osgp(sched, GOSSIP_AXIS)
+    f_sync = make_runner(alg_s, mesh, lr)
+    f_over = make_runner(alg_o, mesh, lr)
+
+    def val_view(alg):
+        return jax.jit(jax.shard_map(
+            alg.val_params, mesh=mesh,
+            in_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+            out_specs=P(GOSSIP_AXIS)))
+
+    vs, vo = val_view(alg_s), val_view(alg_o)  # jit once, not per step
+    p_s = X0.copy()
+    p_o = X0.copy()
+    gs_s = stack_state(alg_s.init(jnp.zeros((DIM,), jnp.float32)))
+    gs_o = stack_state(alg_o.init(jnp.zeros((DIM,), jnp.float32)))
+    for k in range(7):
+        p_s, gs_s = f_sync(p_s, gs_s, TARGETS)
+        jax.block_until_ready(p_s)
+        p_o, gs_o = f_over(p_o, gs_o, TARGETS)
+        jax.block_until_ready(p_o)
+        z_sync = np.asarray(vs(p_s, gs_s))
+        z_oval = np.asarray(vo(p_o, gs_o))
+        np.testing.assert_allclose(z_oval, z_sync, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"step {k}")
+    # undrained eval differs (the buffer holds a real share)
+    z_oeval = np.asarray(jax.jit(jax.shard_map(
+        alg_o.eval_params, mesh=mesh,
+        in_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+        out_specs=P(GOSSIP_AXIS)))(p_o, gs_o))
+    assert np.max(np.abs(z_oeval - z_sync)) > 1e-4
+
+
 @pytest.mark.parametrize("staleness", [2, 3])
 def test_osgp_bounded_staleness(mesh, staleness):
     """synch_freq analogue: incoming shares ride `staleness` steps in a
