@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Repo correctness gate: static analysis first (seconds), then tier-1
+# tests.  This is the command CI runs and the command to run before
+# pushing; both stages are CPU-only.
+#
+# Usage: scripts/check.sh [extra pytest args...]
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== sgplint (AST lint + schedule verifier) =="
+python scripts/sgplint.py --check
+
+echo
+echo "== tier-1 tests (CPU, not slow) =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Plain-git pre-commit hook (for environments without the pre-commit
+# tool): sgplint the staged Python files only.
+#
+#     ln -s ../../scripts/pre-commit-sgplint.sh .git/hooks/pre-commit
+
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+
+mapfile -t files < <(git diff --cached --name-only --diff-filter=ACMR \
+    | grep '\.py$' || true)
+if [ "${#files[@]}" -eq 0 ]; then
+    exit 0
+fi
+exec python scripts/sgplint.py --files "${files[@]}"

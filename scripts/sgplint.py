@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""sgplint — static analysis gate for the gossip/TPU stack.
+
+Usage:
+    python scripts/sgplint.py --check             # full gate (CI mode)
+    python scripts/sgplint.py --files a.py b.py   # pre-commit mode
+    python scripts/sgplint.py --update-baseline
+    python scripts/sgplint.py --report            # spectral-gap report
+    python scripts/sgplint.py --rules             # rule catalog
+
+Runs on CPU in seconds; no TPU required.  See the "Analysis & invariants"
+section of ARCHITECTURE.md for the rule catalog.
+"""
+
+import os
+import signal
+import sys
+
+# die quietly when piped into `head` instead of tracebacking
+signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+
+# the schedule verifier imports the package (and therefore jax): force CPU
+# so the gate runs identically on dev boxes, CI, and TPU hosts
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stochastic_gradient_push_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,8 @@ setup(
             "gossip-sgd=stochastic_gradient_push_tpu.run.gossip_sgd:main",
             "gossip-sgd-adpsgd="
             "stochastic_gradient_push_tpu.run.gossip_sgd_adpsgd:main",
+            "sgplint=stochastic_gradient_push_tpu.analysis.cli:"
+            "console_main",
         ],
     },
 )

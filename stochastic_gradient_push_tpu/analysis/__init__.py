@@ -1,0 +1,46 @@
+"""sgplint — static analysis for gossip/TPU correctness invariants.
+
+Two engines, one finding vocabulary:
+
+* :mod:`.astlint` (Engine 1) walks the package source and flags JAX/TPU
+  footguns that the type system cannot see — collective calls whose
+  ``axis_name`` is not a declared mesh axis, host side effects reachable
+  from jitted code, Python control flow on traced values, PRNG-key reuse,
+  donated-buffer reuse, and broad exception handlers in library code.
+* :mod:`.verifier` (Engine 2) imports the topology layer and *executes*
+  the schedule generators over a grid of world sizes, statically checking
+  the algebraic invariants push-sum convergence rests on: every
+  ``ppermute`` table is a bijection, every mixing matrix is
+  column-stochastic, every full rotation cycle is an ergodic contraction
+  (positive spectral gap), and every bilateral pairing is an involution.
+
+``scripts/sgplint.py`` is the CLI; ``tests/test_sgplint.py`` runs both
+engines in tier-1 on CPU.  Findings carry ``file:line``, a rule id from
+:data:`.findings.RULES`, and a one-line fix hint; a checked-in baseline
+(``sgplint.baseline.json``) grandfathers old findings with zero tolerance
+for new ones.
+"""
+
+from .findings import Finding, RULES, load_baseline, save_baseline
+from .astlint import lint_paths, lint_file
+from .verifier import (
+    verify_package,
+    verify_module,
+    verify_schedule,
+    verify_pairing,
+    DEFAULT_WORLD_SIZES,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "load_baseline",
+    "save_baseline",
+    "lint_paths",
+    "lint_file",
+    "verify_package",
+    "verify_module",
+    "verify_schedule",
+    "verify_pairing",
+    "DEFAULT_WORLD_SIZES",
+]

@@ -1,0 +1,578 @@
+"""Engine 1: AST lint for JAX/TPU footguns.
+
+Pure ``ast``-based — no imports of the linted code, so it runs on any
+file in milliseconds and can never be broken by an import-time crash in
+the target.  The analysis is deliberately precision-first: every rule
+fires only on patterns it can resolve through the module's own import
+aliases and constants, because a lint that cries wolf gets deleted.
+
+Traced-code discovery (the scope for SGPL002/003/004/008):
+
+* functions decorated with ``jax.jit`` / ``jax.pmap`` / ``shard_map`` /
+  ``functools.partial(jax.jit, ...)``;
+* functions passed as the callable to ``jax.jit(...)`` /
+  ``jax.shard_map(...)`` / ``jax.pmap(...)`` / ``jax.grad`` /
+  ``jax.value_and_grad`` / ``jax.vmap`` / ``jax.checkpoint`` anywhere in
+  the module (including nested wraps like ``jax.jit(shard_map(f, ...))``);
+* functions lexically nested inside a traced function;
+* local functions *called by name* from a traced function (one-module
+  call-graph closure — the ``step_fn``-builder idiom).
+
+Suppressions: a ``# sgplint: disable=SGPL007`` (comma-separated ids, or
+``all``) comment on the finding's line or the line directly above it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding
+
+__all__ = ["lint_file", "lint_paths", "collect_axis_vocabulary",
+           "COLLECTIVE_FNS", "iter_py_files"]
+
+
+# canonical dotted names of named-axis collectives whose axis argument the
+# axis-vocabulary rule (SGPL001) checks
+COLLECTIVE_FNS = {
+    "jax.lax.ppermute", "jax.lax.pshuffle", "jax.lax.psum",
+    "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.psum_scatter", "jax.lax.all_gather", "jax.lax.all_to_all",
+    "jax.lax.axis_index", "jax.lax.axis_size",
+}
+
+# canonical names whose call wraps a function into traced code
+_TRACING_WRAPPERS = {
+    "jax.jit", "jax.pmap", "jax.shard_map", "jax.vmap", "jax.grad",
+    "jax.value_and_grad", "jax.checkpoint", "jax.remat",
+    "jax.experimental.shard_map.shard_map",
+}
+
+# canonical names of host-side-effect calls banned in traced code (SGPL002)
+_HOST_EFFECTS = {
+    "print", "input", "open",
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.sleep",
+}
+
+# jax.random callables that *refresh* rather than consume a key
+_KEY_REFRESHERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data"}
+
+_SUPPRESS_RE = re.compile(r"#\s*sgplint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+# paths (relative, substring match on separators) where SGPL007 does not
+# apply: CLI entry points and harnesses legitimately catch broadly at the
+# top of the process
+_BROAD_EXCEPT_EXEMPT_PARTS = ("run", "tests", "scripts", "examples",
+                              "launch", "fixtures_ok_broad")
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chain as a dotted string, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Module:
+    """Per-file context: aliases, constants, suppressions, traced set."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.aliases: dict[str, str] = {}     # local name -> canonical prefix
+        self.constants: dict[str, str] = {}   # module-level NAME -> str value
+        self._collect_imports()
+        self._collect_constants()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def _collect_constants(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.constants[node.targets[0].id] = node.value.value
+
+    def canonical(self, node: ast.AST) -> str | None:
+        """Resolve a call target through the module's import aliases."""
+        name = _dotted(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        head = self.aliases.get(head, head)
+        full = f"{head}.{rest}" if rest else head
+        # normalize the common jax spellings to one canonical form
+        full = full.replace("jax.numpy", "jnp@") \
+                   .replace("numpy.random", "np.random") \
+                   .replace("numpy", "np").replace("jnp@", "jax.numpy")
+        if full.startswith("lax."):
+            full = "jax." + full
+        if full.startswith("random.") and self.aliases.get("random", "") \
+                == "jax.random":
+            full = "jax." + full
+        if full == "shard_map" or full.endswith(".shard_map"):
+            full = "jax.shard_map"
+        return full
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[ln - 1])
+                if m:
+                    ids = m.group(1)
+                    if ids.strip() == "all" or rule in (
+                            s.strip() for s in ids.split(",")):
+                        return True
+        return False
+
+
+def _func_name_args(mod: _Module, call: ast.Call):
+    """(canonical callee, positional args) with functools.partial unwrapped."""
+    fn = mod.canonical(call.func)
+    if fn in ("functools.partial", "partial") and call.args:
+        inner = mod.canonical(call.args[0])
+        return inner, call.args[1:]
+    return fn, call.args
+
+
+def _collect_traced(mod: _Module) -> set[ast.AST]:
+    """Function nodes whose bodies execute under tracing."""
+    funcs: dict[str, list[ast.AST]] = {}
+    traced: set[ast.AST] = set()
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = mod.canonical(target)
+                if name in _TRACING_WRAPPERS:
+                    traced.add(node)
+                elif isinstance(dec, ast.Call) and name in (
+                        "functools.partial", "partial") and dec.args \
+                        and mod.canonical(dec.args[0]) in _TRACING_WRAPPERS:
+                    traced.add(node)
+
+    # functions handed to a tracing wrapper by name, even through nesting:
+    # jax.jit(shard_map(step, ...), donate_argnums=0)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn, args = _func_name_args(mod, node)
+        if fn in _TRACING_WRAPPERS:
+            stack = list(args[:1])
+            while stack:
+                a = stack.pop()
+                if isinstance(a, ast.Name) and a.id in funcs:
+                    traced.update(funcs[a.id])
+                elif isinstance(a, ast.Call):
+                    if mod.canonical(a.func) in ("functools.partial",
+                                                 "partial"):
+                        # jit(partial(step, cfg)): the callable is the
+                        # partial's first arg, not its bound args
+                        stack.extend(a.args[:1])
+                    else:
+                        _, inner_args = _func_name_args(mod, a)
+                        stack.extend(inner_args[:1])
+
+    # lexical containment + one-module call-graph closure
+    def body_calls(fn_node):
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                yield n.func.id
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node not in traced:
+                continue
+            for child in ast.walk(node):
+                if child is not node and isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and child not in traced:
+                    traced.add(child)
+                    changed = True
+            for callee in body_calls(node):
+                for f in funcs.get(callee, ()):
+                    if f not in traced:
+                        traced.add(f)
+                        changed = True
+    return traced
+
+
+def collect_axis_vocabulary(paths) -> set[str]:
+    """Mesh axis names declared anywhere under ``paths``.
+
+    Sources: module-level ``*_AXIS = "name"`` constants, and string
+    literals inside the axis-names tuple of any ``Mesh(...)`` /
+    ``make_*_mesh(...)`` call (Name elements are resolved through the
+    module's string constants).
+    """
+    axes: set[str] = set()
+    for path in iter_py_files(paths):
+        try:
+            source = open(path).read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        mod = _Module(path, source, tree)
+        for name, val in mod.constants.items():
+            if name.endswith("_AXIS"):
+                axes.add(val)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func) or ""
+            if not (callee.endswith("Mesh") or "mesh" in callee.lower()):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for el in arg.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            axes.add(el.value)
+                        elif isinstance(el, ast.Name) \
+                                and el.id in mod.constants:
+                            axes.add(mod.constants[el.id])
+    return axes
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, mod: _Module, axes: set[str], relpath: str):
+        self.mod = mod
+        self.axes = axes
+        self.relpath = relpath
+        self.traced = _collect_traced(mod)
+        self.findings: list[Finding] = []
+        self._fn_stack: list[ast.AST] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def add(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if not self.mod.suppressed(line, rule):
+            self.findings.append(
+                Finding(self.relpath, line, rule, message))
+
+    def in_traced(self) -> bool:
+        return any(f in self.traced for f in self._fn_stack)
+
+    def _contains_traced_math(self, expr: ast.AST) -> bool:
+        """Does ``expr`` evaluate jnp/lax calls (a traced value)?"""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                name = self.mod.canonical(n.func)
+                if name and (name.startswith("jax.numpy.")
+                             or name.startswith("jax.lax.")):
+                    return True
+                if isinstance(n.func, ast.Attribute) and n.func.attr in (
+                        "any", "all", "item", "sum", "max", "min") \
+                        and self._contains_traced_math(n.func.value):
+                    return True
+        return False
+
+    # -- function scope tracking ------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        self._fn_stack.append(node)
+        self._check_prng_reuse(node)
+        self._check_donated_reuse(node)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- SGPL007: broad except --------------------------------------------
+
+    def visit_ExceptHandler(self, node):
+        parts = self.relpath.replace("\\", "/").split("/")
+        exempt = any(p in _BROAD_EXCEPT_EXEMPT_PARTS for p in parts)
+        if not exempt:
+            names = []
+            t = node.type
+            if t is None:
+                names = [None]
+            elif isinstance(t, ast.Tuple):
+                names = [_dotted(e) for e in t.elts]
+            else:
+                names = [_dotted(t)]
+            broad = [n for n in names
+                     if n is None or n in ("Exception", "BaseException")]
+            if broad:
+                what = "bare except" if broad == [None] and t is None \
+                    else f"except {broad[0]}"
+                self.add(node, "SGPL007",
+                         f"{what} in library code swallows unrelated "
+                         "failures")
+        self.generic_visit(node)
+
+    # -- SGPL001: axis vocabulary -----------------------------------------
+
+    def visit_Call(self, node):
+        name = self.mod.canonical(node.func)
+        if name in COLLECTIVE_FNS:
+            self._check_axis_arg(node, name)
+        if self.in_traced():
+            self._check_host_effect(node, name)
+        self.generic_visit(node)
+
+    def _check_axis_arg(self, node: ast.Call, fn: str) -> None:
+        short = fn.rsplit(".", 1)[1]
+        # axis position: first arg for axis_index/axis_size, second
+        # (or axis_name kwarg) for the data collectives
+        cand = []
+        if short in ("axis_index", "axis_size"):
+            if node.args:
+                cand.append(node.args[0])
+        elif len(node.args) >= 2:
+            cand.append(node.args[1])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                cand.append(kw.value)
+        for a in cand:
+            vals = []
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                vals = [a.value]
+            elif isinstance(a, ast.Name) and a.id in self.mod.constants:
+                vals = [self.mod.constants[a.id]]
+            elif isinstance(a, (ast.Tuple, ast.List)):
+                for el in a.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        vals.append(el.value)
+            for v in vals:
+                if v not in self.axes:
+                    self.add(node, "SGPL001",
+                             f"{short} over axis '{v}' which no mesh "
+                             f"declares (known: {sorted(self.axes)})")
+
+    # -- SGPL002/003: host effects in traced code -------------------------
+
+    def _check_host_effect(self, node: ast.Call, name: str | None) -> None:
+        if name in _HOST_EFFECTS:
+            self.add(node, "SGPL002",
+                     f"call to {name}() runs at trace time only, not per "
+                     "step")
+            return
+        if name and name.startswith("np.random."):
+            self.add(node, "SGPL003",
+                     f"{name}() samples once at trace time; the value is "
+                     "baked into the compiled program")
+            return
+        if isinstance(node.func, ast.Attribute) and node.func.attr == \
+                "item" and not node.args:
+            self.add(node, "SGPL002",
+                     ".item() forces a host sync inside traced code")
+
+    # -- SGPL004: Python control flow on traced values ---------------------
+
+    def visit_If(self, node):
+        if self.in_traced() and self._contains_traced_math(node.test):
+            self.add(node, "SGPL004",
+                     "Python `if` on a traced value — this branches at "
+                     "trace time (ConcretizationTypeError at best)")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.in_traced() and self._contains_traced_math(node.test):
+            self.add(node, "SGPL004",
+                     "Python `while` on a traced value cannot be staged")
+        self.generic_visit(node)
+
+    # -- SGPL008: global mutation in traced code ---------------------------
+
+    def visit_Global(self, node):
+        if self.in_traced():
+            fn = self._fn_stack[-1]
+            assigns = {
+                t.id
+                for n in ast.walk(fn)
+                for t in getattr(n, "targets", [])
+                if isinstance(t, ast.Name)
+            }
+            for name in node.names:
+                if name in assigns:
+                    self.add(node, "SGPL008",
+                             f"traced function rebinds global '{name}' — "
+                             "the write happens once, at trace time")
+        self.generic_visit(node)
+
+    # -- SGPL005: PRNG key reuse ------------------------------------------
+
+    def _check_prng_reuse(self, fn) -> None:
+        # ast.walk order is not execution order: gather (line, event)
+        # pairs first, then replay them sorted.  Straight-line
+        # approximation — good enough for a lint, and rebinds reset state.
+        events: list[tuple[int, int, str, object]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                # tuple unpack of split(): every element is a fresh key
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        names += [e.id for e in t.elts
+                                  if isinstance(e, ast.Name)]
+                kind = "rebind"
+                if isinstance(node.value, ast.Call):
+                    callee = self.mod.canonical(node.value.func) or ""
+                    if callee in ("jax.random.PRNGKey", "jax.random.key",
+                                  "jax.random.split",
+                                  "jax.random.fold_in"):
+                        kind = "fresh-key"
+                events.append((node.lineno, node.col_offset, kind, names))
+            elif isinstance(node, ast.Call):
+                callee = self.mod.canonical(node.func) or ""
+                if not callee.startswith("jax.random."):
+                    continue
+                tail = callee.rsplit(".", 1)[1]
+                if tail in _KEY_REFRESHERS or tail in ("PRNGKey", "key"):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name):
+                    events.append((node.lineno, node.col_offset,
+                                   "consume", node))
+
+        key_vars: set[str] = set()
+        consumed: dict[str, int] = {}
+        for _, _, kind, payload in sorted(events, key=lambda e: e[:2]):
+            if kind == "consume":
+                node = payload
+                var = node.args[0].id
+                if var in key_vars:
+                    if var in consumed:
+                        self.add(node, "SGPL005",
+                                 f"key '{var}' already consumed by "
+                                 f"jax.random call at line "
+                                 f"{consumed[var]}; identical streams")
+                    else:
+                        consumed[var] = node.lineno
+            elif kind == "fresh-key":
+                for n in payload:
+                    key_vars.add(n)
+                    consumed.pop(n, None)
+            else:
+                for n in payload:
+                    key_vars.discard(n)
+                    consumed.pop(n, None)
+
+    # -- SGPL006: donated buffer reuse ------------------------------------
+
+    def _check_donated_reuse(self, fn) -> None:
+        donating: dict[str, set[int]] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            callee, _ = _func_name_args(self.mod, node.value)
+            if callee not in ("jax.jit", "jax.pmap"):
+                continue
+            idxs: set[int] = set()
+            for kw in node.value.keywords:
+                if kw.arg == "donate_argnums":
+                    if isinstance(kw.value, ast.Constant):
+                        idxs.add(int(kw.value.value))
+                    elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                        idxs |= {int(e.value) for e in kw.value.elts
+                                 if isinstance(e, ast.Constant)}
+            if idxs:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donating[t.id] = idxs
+
+        if not donating:
+            return
+        donated_at: dict[str, int] = {}  # var -> line it was donated
+        rebinds: dict[str, list[int]] = {}  # var -> lines it is re-assigned
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in donating:
+                for i in donating[node.func.id]:
+                    if i < len(node.args) \
+                            and isinstance(node.args[i], ast.Name):
+                        donated_at.setdefault(node.args[i].id, node.lineno)
+            elif isinstance(node, ast.Assign):
+                targets = [t for t in node.targets]
+                for t in list(targets):
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(t.elts)
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        rebinds.setdefault(t.id, []).append(node.lineno)
+        if not donated_at:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in donated_at \
+                    and node.lineno > donated_at[node.id]:
+                don = donated_at[node.id]
+                # `x = step(x, ...)` rebinds the name to the fresh result:
+                # later reads are the new buffer, not the donated one
+                if any(don <= r < node.lineno
+                       for r in rebinds.get(node.id, ())):
+                    continue
+                self.add(node, "SGPL006",
+                         f"'{node.id}' was donated at line {don}; its "
+                         "buffer may already be reused")
+                donated_at.pop(node.id)
+
+
+def lint_file(path: str, axes: set[str], relto: str | None = None
+              ) -> list[Finding]:
+    source = open(path).read()
+    tree = ast.parse(source, filename=path)
+    rel = os.path.relpath(path, relto) if relto else path
+    mod = _Module(path, source, tree)
+    linter = _Linter(mod, axes, rel)
+    linter.visit(tree)
+    return sorted(linter.findings)
+
+
+def lint_paths(paths, axes: set[str] | None = None,
+               relto: str | None = None) -> list[Finding]:
+    """Lint every ``.py`` under ``paths``; axis vocabulary defaults to
+    what the same paths declare."""
+    if axes is None:
+        axes = collect_axis_vocabulary(paths)
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f, axes, relto=relto))
+    return sorted(findings)

@@ -1,0 +1,146 @@
+"""sgplint command-line driver (see ``scripts/sgplint.py``).
+
+Modes:
+
+* default / ``--check`` — run both engines over the package, compare
+  against the checked-in baseline, exit 1 on any new finding;
+* ``--update-baseline`` — rewrite the baseline to the current findings;
+* ``--files a.py b.py`` — AST-lint only the given files (pre-commit
+  mode; the semantic verifier and baseline comparison still run only in
+  full mode);
+* ``--report`` — print the spectral-gap report (worst configurations
+  first) after verification.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .astlint import collect_axis_vocabulary, lint_paths, lint_file
+from .findings import (RULES, load_baseline, partition_against_baseline,
+                       save_baseline)
+from .verifier import verify_package
+
+DEFAULT_BASELINE = "sgplint.baseline.json"
+
+
+def repo_root() -> str:
+    """The directory holding the package (assumes src checkout layout)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def package_dir() -> str:
+    return os.path.join(repo_root(), "stochastic_gradient_push_tpu")
+
+
+def run_full(baseline_path: str, update: bool, report: bool,
+             quiet: bool = False) -> int:
+    root = repo_root()
+    findings = lint_paths([package_dir()], relto=root)
+    sem, gaps = verify_package(relto=root)
+    findings = sorted(findings + sem)
+
+    baseline = load_baseline(baseline_path)
+    new, old = partition_against_baseline(findings, baseline)
+
+    if update:
+        save_baseline(baseline_path, findings)
+        print(f"baseline updated: {len(findings)} finding(s) recorded "
+              f"in {baseline_path}")
+        return 0
+
+    out = sys.stdout
+    if report and gaps:
+        worst = sorted(gaps, key=lambda g: g.gap)[:15]
+        print("spectral-gap report (worst 15 of "
+              f"{len(gaps)} configurations):", file=out)
+        for g in worst:
+            print(f"  gap={g.gap:.4f}  {g.topology}(world={g.world}, "
+                  f"ppi={g.ppi}, mixing={g.mixing})", file=out)
+
+    if not quiet:
+        for f in new:
+            print(f.render(), file=out)
+    if old and not quiet:
+        print(f"({len(old)} grandfathered finding(s) suppressed by "
+              f"baseline)", file=out)
+    if new:
+        print(f"sgplint: {len(new)} new finding(s) "
+              f"({len(findings)} total, {len(old)} baselined)", file=out)
+        return 1
+    print(f"sgplint: clean ({len(old)} baselined, "
+          f"{len(gaps)} schedule configurations verified)", file=out)
+    return 0
+
+
+def run_files(files: list[str]) -> int:
+    root = repo_root()
+    axes = collect_axis_vocabulary([package_dir()])
+    findings = []
+    bad_args = []
+    for f in files:
+        if not os.path.exists(f):
+            bad_args.append(f"{f}: no such file")
+        elif not f.endswith(".py"):
+            bad_args.append(f"{f}: not a .py file")
+        else:
+            findings.extend(lint_file(f, axes, relto=root))
+    for f in findings:
+        print(f.render())
+    for msg in bad_args:
+        print(f"sgplint: error: {msg}", file=sys.stderr)
+    if bad_args:
+        # a vacuous pass on a typo'd path must not look like a clean lint
+        return 2
+    if findings:
+        print(f"sgplint: {len(findings)} finding(s) in "
+              f"{len(files)} file(s)")
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sgplint",
+        description="JAX/TPU-aware static analysis for gossip schedules, "
+                    "collective usage, and trace safety")
+    ap.add_argument("--check", action="store_true",
+                    help="full run: AST lint + schedule verifier vs "
+                         "baseline (default mode)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings")
+    ap.add_argument("--files", nargs="*", default=None,
+                    help="AST-lint only these files (pre-commit mode)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default <repo>/"
+                         f"{DEFAULT_BASELINE})")
+    ap.add_argument("--report", action="store_true",
+                    help="print the spectral-gap report")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rid, (summary, hint) in sorted(RULES.items()):
+            print(f"{rid}  {summary}\n        fix: {hint}")
+        return 0
+
+    if args.files is not None:
+        return run_files(args.files)
+
+    baseline = args.baseline or os.path.join(repo_root(), DEFAULT_BASELINE)
+    return run_full(baseline, update=args.update_baseline,
+                    report=args.report)
+
+
+def console_main() -> int:
+    """`sgplint` console-script entry: same environment discipline as
+    scripts/sgplint.py (CPU backend, quiet SIGPIPE)."""
+    import signal
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    return main()

@@ -1,0 +1,122 @@
+"""Finding type, rule catalog, and baseline bookkeeping for sgplint."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+__all__ = ["Finding", "RULES", "load_baseline", "save_baseline",
+           "partition_against_baseline"]
+
+
+# rule id -> (summary, fix hint).  L-rules come from the AST engine,
+# V-rules from the semantic schedule verifier.  The catalog is the single
+# source of truth: ARCHITECTURE.md's rule table is generated from the same
+# ids, and tests assert every rule here has a firing fixture.
+RULES: dict[str, tuple[str, str]] = {
+    "SGPL001": (
+        "collective axis_name is not a declared mesh axis",
+        "use an axis constant from parallel/mesh.py or train/lm.py "
+        "(GOSSIP_AXIS, SEQ_AXIS, ...) or declare the axis on a Mesh"),
+    "SGPL002": (
+        "host side effect inside jit/shard_map-traced code",
+        "hoist the call out of the traced function, or use jax.debug.print "
+        "/ jax.debug.callback for tracing-safe effects"),
+    "SGPL003": (
+        "numpy RNG inside jit/shard_map-traced code (freezes at trace time)",
+        "thread a jax.random key through the function instead"),
+    "SGPL004": (
+        "Python control flow on a traced value (retraces or fails)",
+        "use lax.cond/lax.select/jnp.where, or mark the operand static"),
+    "SGPL005": (
+        "PRNG key reused across sampler calls without split/fold_in",
+        "key, sub = jax.random.split(key) before each extra use"),
+    "SGPL006": (
+        "argument donated to a jitted call is read after the call",
+        "stop using the donated buffer, or drop donate_argnums for it"),
+    "SGPL007": (
+        "bare/broad exception handler in library code",
+        "catch the specific exception types the body can raise, or tag a "
+        "deliberate catch-all with '# sgplint: disable=SGPL007 (<why>)'"),
+    "SGPL008": (
+        "global-state mutation inside jit/shard_map-traced code",
+        "return the new value instead; traced functions must be pure"),
+    "SGPV101": (
+        "gossip phase sub-round is not a permutation (ppermute would drop "
+        "or duplicate messages)",
+        "fix the topology so each rank has exactly one in-edge per "
+        "sub-round"),
+    "SGPV102": (
+        "mixing matrix is not column-stochastic (push-sum mass not "
+        "conserved)",
+        "make self_weight[r] + sum(edge_weights[:, r]) == 1 for every rank"),
+    "SGPV103": (
+        "rotation cycle is not an ergodic contraction (zero spectral gap; "
+        "the paper's convergence rate assumes a positive gap)",
+        "add edges or phases until the cycle product mixes every pair of "
+        "ranks"),
+    "SGPV104": (
+        "bilateral pairing row is not an involution (partner mismatch "
+        "deadlocks the exchange)",
+        "ensure pairing[p, pairing[p, r]] == r for every rank"),
+    "SGPV105": (
+        "schedule generator raised unexpectedly for a supported "
+        "configuration",
+        "make the generator either produce a valid schedule or raise "
+        "ValueError with a clear unsupported-configuration message"),
+}
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One analyzer finding, printable as ``file:line: RULE message``."""
+
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self, hint: bool = True) -> str:
+        s = f"{self.file}:{self.line}: {self.rule} {self.message}"
+        if hint and self.rule in RULES:
+            s += f"\n    hint: {RULES[self.rule][1]}"
+        return s
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line numbers shift too easily to key on."""
+        return (self.file, self.rule, self.message)
+
+
+def load_baseline(path: str) -> set[tuple[str, str, str]]:
+    """Grandfathered finding keys; an absent file is an empty baseline."""
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return {(d["file"], d["rule"], d["message"]) for d in data["findings"]}
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    data = {
+        "comment": "sgplint grandfather list — regenerate with "
+                   "`python scripts/sgplint.py --update-baseline`; new "
+                   "findings are never tolerated, only these exact keys.",
+        "findings": [
+            {"file": f.file, "rule": f.rule, "message": f.message}
+            for f in sorted(findings)
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def partition_against_baseline(findings: list[Finding],
+                               baseline: set[tuple[str, str, str]]
+                               ) -> tuple[list[Finding], list[Finding]]:
+    """Split into (new, grandfathered)."""
+    new, old = [], []
+    for f in findings:
+        (old if f.key() in baseline else new).append(f)
+    return new, old

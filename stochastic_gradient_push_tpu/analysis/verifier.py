@@ -1,0 +1,321 @@
+"""Engine 2: semantic verification of gossip schedules.
+
+Unlike the AST engine this one *imports and executes* the topology layer:
+it enumerates every registered graph topology over a grid of world sizes,
+peer counts, and mixing strategies, builds the actual
+:class:`~..topology.schedule.GossipSchedule` tables that the collective
+layer would bake into ``lax.ppermute`` programs, and checks the algebraic
+invariants the paper's convergence analysis rests on:
+
+* **SGPV101** every phase sub-round is a bijection of the gossip axis —
+  the precondition for lowering a gossip sub-round to one ``ppermute``
+  (a non-bijective table silently drops or duplicates messages);
+* **SGPV102** every mixing matrix is column-stochastic — push-sum mass
+  conservation (Assran et al. 2018, eq. 4);
+* **SGPV103** the product of one full rotation cycle is an ergodic
+  contraction: second-largest eigenvalue modulus strictly below 1.  The
+  paper's rate bound degrades as ``1/(1-λ₂)``, so the verifier also
+  *reports* the per-configuration spectral gap for ROADMAP tracking;
+* **SGPV104** every bilateral pairing row is an involution (partner
+  mismatch would deadlock the synchronous exchange);
+* **SGPV105** generators must either produce a valid schedule or refuse
+  a configuration with a clear ``ValueError`` — anything else is a bug.
+
+All checks run on CPU in seconds: tables are numpy, never traced.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import numpy as np
+
+from .findings import Finding
+
+__all__ = ["verify_schedule", "verify_pairing", "verify_topology",
+           "verify_module", "verify_package", "DEFAULT_WORLD_SIZES",
+           "GapEntry"]
+
+# 2..64 per the convergence-grid contract: powers of two (pod slices),
+# odd/even non-powers (the shapes that break naive schedules)
+DEFAULT_WORLD_SIZES = (2, 3, 4, 5, 6, 8, 12, 16, 24, 32, 48, 64)
+
+DEFAULT_PEER_COUNTS = (1, 2, 4)
+
+# ergodicity tolerance: a gap at/below this means the cycle product does
+# not contract and push-sum cannot converge
+GAP_HARD_MIN = 1e-9
+
+_COLUMN_TOL = 1e-9
+
+
+class GapEntry(tuple):
+    """(topology, world, peers_per_itr, mixing, gap) report row."""
+
+    __slots__ = ()
+
+    def __new__(cls, topology, world, ppi, mixing, gap):
+        return super().__new__(cls, (topology, world, ppi, mixing, gap))
+
+    topology = property(lambda s: s[0])
+    world = property(lambda s: s[1])
+    ppi = property(lambda s: s[2])
+    mixing = property(lambda s: s[3])
+    gap = property(lambda s: s[4])
+
+
+def _site(obj) -> tuple[str, int]:
+    """(file, line) of the object's defining source, best effort."""
+    try:
+        path = inspect.getsourcefile(type(obj) if not inspect.isclass(obj)
+                                     else obj)
+        _, line = inspect.getsourcelines(type(obj) if not
+                                         inspect.isclass(obj) else obj)
+        return path or "<unknown>", line
+    except (OSError, TypeError):
+        return "<unknown>", 0
+
+
+def _mixing_matrix(schedule, phase: int) -> np.ndarray:
+    """Dense W for one phase, built from the raw tables (does not trust a
+    fixture object's own ``mixing_matrix`` method)."""
+    n = schedule.world_size
+    w = np.zeros((n, n), dtype=np.float64)
+    for src in range(n):
+        w[src, src] += schedule.self_weight[phase, src]
+        for i in range(schedule.peers_per_itr):
+            w[schedule.perms[phase, i, src], src] += \
+                schedule.edge_weights[phase, i, src]
+    return w
+
+
+def spectral_gap(schedule) -> float:
+    """``1 - |λ₂|`` of the full rotation-cycle product."""
+    n = schedule.world_size
+    prod = np.eye(n)
+    for p in range(schedule.num_phases):
+        prod = _mixing_matrix(schedule, p) @ prod
+    lam = np.sort(np.abs(np.linalg.eigvals(prod)))[::-1]
+    return float(1.0 - (lam[1] if n > 1 else 0.0))
+
+
+def verify_schedule(schedule, label: str, file: str, line: int
+                    ) -> tuple[list[Finding], float]:
+    """Check bijection + column-stochasticity + ergodicity of one
+    schedule-like object (anything with perms/self_weight/edge_weights/
+    num_phases/world_size/peers_per_itr).  Returns (findings, gap)."""
+    findings: list[Finding] = []
+    n = schedule.world_size
+    ident = np.arange(n)
+
+    for p in range(schedule.num_phases):
+        for i in range(schedule.peers_per_itr):
+            dests = np.asarray(schedule.perms[p, i])
+            if not np.array_equal(np.sort(dests), ident):
+                findings.append(Finding(
+                    file, line, "SGPV101",
+                    f"{label}: phase {p} sub-round {i} destination table "
+                    f"is not a permutation of range({n})"))
+        totals = (np.asarray(schedule.self_weight[p], dtype=np.float64)
+                  + np.asarray(schedule.edge_weights[p],
+                               dtype=np.float64).sum(axis=0))
+        bad = np.abs(totals - 1.0) > _COLUMN_TOL
+        if bad.any():
+            ranks = np.flatnonzero(bad)[:4].tolist()
+            findings.append(Finding(
+                file, line, "SGPV102",
+                f"{label}: phase {p} column sums deviate from 1 at ranks "
+                f"{ranks} (push-sum mass not conserved)"))
+
+    gap = float("nan")
+    if not findings:  # gap is meaningless on malformed tables
+        gap = spectral_gap(schedule)
+        if n > 1 and gap <= GAP_HARD_MIN:
+            findings.append(Finding(
+                file, line, "SGPV103",
+                f"{label}: rotation cycle has zero spectral gap "
+                f"(|λ₂| ≈ 1); gossip cannot reach consensus"))
+    return findings, gap
+
+
+def verify_pairing(pairing: np.ndarray, label: str, file: str, line: int
+                   ) -> list[Finding]:
+    """Check each pairing row is a fixed-point-free involution."""
+    findings: list[Finding] = []
+    pairing = np.asarray(pairing)
+    num_phases, n = pairing.shape
+    ident = np.arange(n)
+    for p in range(num_phases):
+        row = pairing[p]
+        ok = (np.array_equal(np.sort(row), ident)
+              and np.array_equal(row[row], ident)
+              and (n == 1 or not np.any(row == ident)))
+        if not ok:
+            findings.append(Finding(
+                file, line, "SGPV104",
+                f"{label}: pairing phase {p} is not a fixed-point-free "
+                f"involution"))
+    return findings
+
+
+def _is_unsupported(err: ValueError) -> bool:
+    """Constructor refusals that mean 'configuration unsupported', not
+    'generator broken'."""
+    msg = str(err)
+    needles = ("unsupported", "even world size", "exceeds phone-book",
+               "no hop distance", "requires an even", "must be >=")
+    return any(s in msg for s in needles)
+
+
+def _mixing_grid(world: int):
+    from ..topology.mixing import SelfWeightedMixing, UniformMixing
+    yield "uniform", UniformMixing()
+    yield "self-weighted(0.5)", SelfWeightedMixing(0.5)
+    if world > 1:
+        yield ("self-weighted(per-rank)",
+               SelfWeightedMixing(np.linspace(0.2, 0.8, world)))
+
+
+def verify_topology(graph_cls, world: int, ppi: int,
+                    check_pairing: bool = True
+                    ) -> tuple[list[Finding], list[GapEntry], bool]:
+    """Verify one (topology class, world, peers_per_itr) cell over the
+    mixing grid.  Returns (findings, gap report rows, supported)."""
+    from ..topology.schedule import build_pairing_schedule, build_schedule
+
+    file, line = _site(graph_cls)
+    findings: list[Finding] = []
+    gaps: list[GapEntry] = []
+
+    try:
+        graph = graph_cls(world, peers_per_itr=ppi)
+    except ValueError as e:
+        if _is_unsupported(e):
+            return [], [], False
+        findings.append(Finding(
+            file, line, "SGPV105",
+            f"{graph_cls.__name__}(world={world}, ppi={ppi}) raised "
+            f"unexpectedly at construction: {e}"))
+        return findings, [], True
+
+    for mix_name, mixing in _mixing_grid(world):
+        label = (f"{graph_cls.__name__}(world={world}, ppi={ppi}, "
+                 f"mixing={mix_name})")
+        try:
+            schedule = build_schedule(graph, mixing)
+        except ValueError as e:
+            rule = "SGPV101" if "not a permutation" in str(e) else (
+                "SGPV102" if "column" in str(e) else "SGPV105")
+            findings.append(Finding(file, line, rule, f"{label}: {e}"))
+            continue
+        except Exception as e:  # sgplint: disable=SGPL007
+            # (the verifier's job is to report, not crash on, arbitrary
+            # generator failures — the catch IS the feature here)
+            findings.append(Finding(
+                file, line, "SGPV105",
+                f"{label}: build_schedule raised {type(e).__name__}: {e}"))
+            continue
+        fs, gap = verify_schedule(schedule, label, file, line)
+        findings.extend(fs)
+        if np.isfinite(gap):
+            gaps.append(GapEntry(graph_cls.__name__, world, ppi,
+                                 mix_name, gap))
+
+    if check_pairing:
+        try:
+            pairing = build_pairing_schedule(graph)
+        except ValueError as e:
+            if not _is_unsupported(e):
+                findings.append(Finding(
+                    file, line, "SGPV105",
+                    f"{graph_cls.__name__}(world={world}, ppi={ppi}): "
+                    f"build_pairing_schedule raised unexpectedly: {e}"))
+        else:
+            findings.extend(verify_pairing(
+                pairing, f"{graph_cls.__name__}(world={world}, ppi={ppi})",
+                file, line))
+    return findings, gaps, True
+
+
+def verify_package(world_sizes=DEFAULT_WORLD_SIZES,
+                   peer_counts=DEFAULT_PEER_COUNTS,
+                   relto: str | None = None
+                   ) -> tuple[list[Finding], list[GapEntry]]:
+    """Run the full verification grid over every registered topology."""
+    import os
+
+    from ..topology import GRAPH_TOPOLOGIES
+
+    findings: list[Finding] = []
+    gaps: list[GapEntry] = []
+    classes = sorted({cls for cls in GRAPH_TOPOLOGIES.values()
+                      if cls is not None}, key=lambda c: c.__name__)
+    for cls in classes:
+        for world in world_sizes:
+            for ppi in peer_counts:
+                fs, gs, _ = verify_topology(cls, world, ppi)
+                findings.extend(fs)
+                gaps.extend(gs)
+    if relto:
+        findings = [
+            Finding(os.path.relpath(f.file, relto), f.line, f.rule,
+                    f.message)
+            if os.path.isabs(f.file) else f
+            for f in findings
+        ]
+    return sorted(set(findings)), gaps
+
+
+def verify_module(mod, relto: str | None = None) -> list[Finding]:
+    """Verify a module exporting schedule material (fixture protocol).
+
+    Recognized attributes:
+
+    * ``SGPLINT_TOPOLOGIES`` — iterable of :class:`GraphTopology`
+      instances (or zero-arg callables returning one); each is compiled
+      with uniform mixing and fully verified.
+    * ``SGPLINT_SCHEDULES`` — iterable of schedule-like objects (the
+      :class:`GossipSchedule` attribute surface), table-checked directly.
+    * ``SGPLINT_PAIRINGS`` — iterable of ``(num_phases, world)`` int
+      arrays, involution-checked.
+    """
+    import os
+
+    from ..topology.schedule import build_schedule
+
+    file = getattr(mod, "__file__", "<module>")
+    if relto and os.path.isabs(file):
+        file = os.path.relpath(file, relto)
+    findings: list[Finding] = []
+
+    for i, topo in enumerate(getattr(mod, "SGPLINT_TOPOLOGIES", ())):
+        if callable(topo) and not hasattr(topo, "world_size"):
+            topo = topo()
+        label = f"SGPLINT_TOPOLOGIES[{i}]:{type(topo).__name__}"
+        try:
+            schedule = build_schedule(topo)
+        except ValueError as e:
+            rule = "SGPV101" if "not a permutation" in str(e) else (
+                "SGPV102" if "column" in str(e) else "SGPV105")
+            findings.append(Finding(file, 1, rule, f"{label}: {e}"))
+            continue
+        except Exception as e:  # sgplint: disable=SGPL007
+            # (fixture generators may raise anything; report, don't crash)
+            findings.append(Finding(
+                file, 1, "SGPV105",
+                f"{label}: build_schedule raised "
+                f"{type(e).__name__}: {e}"))
+            continue
+        fs, _ = verify_schedule(schedule, label, file, 1)
+        findings.extend(fs)
+
+    for i, sched in enumerate(getattr(mod, "SGPLINT_SCHEDULES", ())):
+        fs, _ = verify_schedule(
+            sched, f"SGPLINT_SCHEDULES[{i}]", file, 1)
+        findings.extend(fs)
+
+    for i, pairing in enumerate(getattr(mod, "SGPLINT_PAIRINGS", ())):
+        findings.extend(verify_pairing(
+            pairing, f"SGPLINT_PAIRINGS[{i}]", file, 1))
+
+    return sorted(findings)

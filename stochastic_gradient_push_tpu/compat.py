@@ -1,0 +1,83 @@
+"""Compatibility shims for the span of JAX releases this package runs on.
+
+The code targets the modern JAX surface (``jax.shard_map``,
+``lax.axis_size``, ``lax.pvary``/``lax.pcast``); older releases (0.4.x)
+spell these ``jax.experimental.shard_map.shard_map`` (with ``auto=`` instead
+of ``axis_names=`` and ``check_rep=`` instead of vma tracking) or lack them
+entirely.  :func:`ensure_jax_compat` installs the missing aliases once, at
+package import, so every module and test can use the modern names
+unconditionally.
+
+Shim semantics on 0.4.x:
+
+* ``jax.shard_map(f, mesh=, in_specs=, out_specs=, axis_names=)`` — the
+  ``axis_names`` manual set is translated to its complement ``auto=`` set;
+  replication checking is disabled (``check_rep=False``) because the vma
+  rules the code is written against do not exist, and the old rep analysis
+  rejects valid programs that rely on them.
+* ``lax.axis_size(name)`` — ``lax.psum(1, name)``, which constant-folds to
+  the static axis size inside ``shard_map``.
+* ``jax.typeof(x)`` — the raw aval; it has no ``vma`` attribute, which
+  callers already treat as "no varying axes tracked".
+* ``lax.pvary`` / ``lax.pcast(..., to="varying")`` — identity.  Without vma
+  tracking every value is already implicitly varying, so marking is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["ensure_jax_compat"]
+
+_INSTALLED = False
+
+
+def ensure_jax_compat() -> None:
+    """Install modern-JAX aliases on older releases (idempotent)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    _INSTALLED = True
+
+    import jax
+    from jax import lax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map_compat(f, *, mesh, in_specs, out_specs,
+                             axis_names=None, check_vma=None, **kwargs):
+            if axis_names is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+                if auto:
+                    kwargs["auto"] = auto
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map_compat
+
+    if not hasattr(jax, "typeof"):
+        def typeof(x):
+            return jax.core.get_aval(x)
+
+        jax.typeof = typeof
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(lax, "pvary"):
+        def pvary(x, axis_names):
+            return x
+
+        lax.pvary = pvary
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, axis_names, *, to):
+            return x
+
+        lax.pcast = pcast

@@ -109,7 +109,7 @@ def get_native() -> tp.Any | None:
         mod = importlib.util.module_from_spec(spec)
         try:
             spec.loader.exec_module(mod)
-        except Exception as e:
+        except (ImportError, OSError, SystemError) as e:
             # a corrupt or foreign-ABI cached extension degrades to PIL
             # (as documented) instead of crashing the loader; drop the
             # bad .so so the next process rebuilds it

@@ -78,7 +78,9 @@ class DevicePrefetcher:
                     if not put((jax.device_put(x, self.sharding),
                                 jax.device_put(y, self.sharding))):
                         return
-            except BaseException as e:  # surfaces on the consumer side
+            except BaseException as e:  # sgplint: disable=SGPL007
+                # (deliberate transport: surfaces on the consumer side,
+                # which re-raises it — see the isinstance check below)
                 put(e)
                 return
             put(_STOP)

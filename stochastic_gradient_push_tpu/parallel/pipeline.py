@@ -39,7 +39,8 @@ def pvary_missing(x, axes):
     already-varying axis)."""
     try:
         have = jax.typeof(x).vma
-    except Exception:
+    except (AttributeError, TypeError):
+        # older jax: no jax.typeof, or avals without vma tracking
         have = frozenset()
     need = tuple(a for a in axes if a not in have)
     if not need:

@@ -151,9 +151,10 @@ class AsyncBilateralAverager:
                     # long interval (and a post-stop round would read
                     # buffers the loop has moved past)
                     self._stop.wait(self.min_interval_s)
-        except BaseException:  # a dead thread must never be silent:
-            # training would keep running as local SGD while reporting
-            # itself as AD-PSGD
+        except BaseException:  # sgplint: disable=SGPL007
+            # (deliberate catch-log-reraise: a dead thread must never be
+            # silent — training would keep running as local SGD while
+            # reporting itself as AD-PSGD)
             import traceback
 
             from ..utils.logging import make_logger

@@ -58,7 +58,9 @@ def _call_with_timeout(fn, timeout: float, what: str,
     def run():
         try:
             fn()
-        except BaseException as e:  # re-raised on the caller thread
+        except BaseException as e:  # sgplint: disable=SGPL007
+            # (deliberate transport: re-raised verbatim on the caller
+            # thread — narrowing here would swallow what the caller sees)
             err.append(e)
         with lock:
             done.set()
@@ -66,7 +68,11 @@ def _call_with_timeout(fn, timeout: float, what: str,
         if late and not err and on_late_completion is not None:
             try:
                 on_late_completion()
-            except Exception:
+            except (RuntimeError, OSError):
+                # RuntimeError: stop_trace with no active trace (the late
+                # start lost a race with an explicit stop); OSError: the
+                # stop's dump-to-disk failed — either way nothing more to
+                # undo, and a leaked daemon thread must not traceback
                 pass
 
     t = threading.Thread(target=run, daemon=True, name=f"profiler-{what}")
@@ -106,7 +112,9 @@ def start_trace_guarded(log_dir: str,
         return _call_with_timeout(
             lambda: jax.profiler.start_trace(log_dir), timeout, "start",
             on_late_completion=undo_late_start)
-    except Exception as e:
+    except (RuntimeError, OSError, ValueError) as e:
+        # RuntimeError: profiler already active; OSError: unwritable
+        # log_dir; ValueError: bad arguments from the caller's config
         make_logger("profiler").warning(f"start_trace failed: {e}")
         return False
 
@@ -118,7 +126,9 @@ def stop_trace_guarded(timeout: float = _PROFILER_TIMEOUT) -> bool:
     try:
         return _call_with_timeout(
             lambda: jax.profiler.stop_trace(), timeout, "stop")
-    except Exception as e:
+    except (RuntimeError, OSError) as e:
+        # RuntimeError: no trace running (hung start declared dead);
+        # OSError: dump-to-disk failure at stop time
         make_logger("profiler").warning(f"stop_trace failed: {e}")
         return False
 

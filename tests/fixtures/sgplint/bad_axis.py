@@ -1,0 +1,22 @@
+"""SGPL001: collective over an axis name no mesh declares."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PAIRS = [(0, 1), (1, 0)]
+
+
+@jax.jit
+def gossip_step(x):
+    sent = lax.ppermute(x, "gosip", PAIRS)  # EXPECT: SGPL001
+    total = lax.psum(x, axis_name="gossip_axis")  # EXPECT: SGPL001
+    rank = lax.axis_index("gossp")  # EXPECT: SGPL001
+    ok = lax.pmean(x, "gossip")  # correctly-spelled axis: silent
+    return sent + total + rank + ok
+
+
+def not_traced(x):
+    # axis vocabulary applies outside traced code too: the literal is
+    # wrong wherever it is
+    return lax.psum(x, "tpp")  # EXPECT: SGPL001

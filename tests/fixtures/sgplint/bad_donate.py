@@ -1,0 +1,21 @@
+"""SGPL006: reading a buffer after donating it to a jitted call."""
+
+import jax
+import jax.numpy as jnp
+
+
+def update(state, batch):
+    return state + batch
+
+
+def train_two_steps(state, batch):
+    step = jax.jit(update, donate_argnums=(0,))
+    new_state = step(state, batch)
+    stale = state.sum()  # EXPECT: SGPL006
+    return new_state + stale
+
+
+def donation_ok(state, batch):
+    step = jax.jit(update, donate_argnums=(0,))
+    state = step(state, batch)
+    return state.sum()  # rebound to the result: silent

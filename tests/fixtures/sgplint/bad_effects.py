@@ -1,0 +1,45 @@
+"""SGPL002: host side effects reachable from jitted code."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_step(x):
+    print("step!", x)  # EXPECT: SGPL002
+    t0 = time.time()  # EXPECT: SGPL002
+    y = x * 2.0
+    scalar = y.sum().item()  # EXPECT: SGPL002
+    jax.debug.print("loss={l}", l=y.sum())  # tracing-safe: silent
+    return y + scalar + t0
+
+
+def helper(x):
+    # called from the traced function below -> traced by propagation
+    time.sleep(0.1)  # EXPECT: SGPL002
+    return x
+
+
+def outer(x):
+    return helper(x) + 1.0
+
+
+outer_jit = jax.jit(outer)
+
+
+def host_side(x):
+    # NOT traced: effects here are fine
+    print("host logging is allowed")
+    return time.time()
+
+
+def configured_step(cfg, x):
+    # traced via jax.jit(functools.partial(configured_step, ...))
+    print("cfg:", cfg)  # EXPECT: SGPL002
+    return x
+
+
+step_jit = jax.jit(functools.partial(configured_step, {"lr": 0.1}))

@@ -1,0 +1,29 @@
+"""SGPL007: broad exception handlers in library code."""
+
+
+def swallow_everything(path):
+    try:
+        return open(path).read()
+    except Exception:  # EXPECT: SGPL007
+        return None
+
+
+def swallow_harder(path):
+    try:
+        return open(path).read()
+    except:  # noqa: E722  # EXPECT: SGPL007
+        return None
+
+
+def narrow_ok(path):
+    try:
+        return open(path).read()
+    except (OSError, UnicodeDecodeError):
+        return None
+
+
+def tagged_ok(fn):
+    try:
+        return fn()
+    except Exception:  # sgplint: disable=SGPL007 (plugin boundary)
+        return None

@@ -1,0 +1,23 @@
+"""SGPV103: a structurally valid schedule that can never reach consensus.
+
+Every phase swaps 0<->1 and 2<->3: each sub-round is a bijection and the
+mixing matrix is column-stochastic, but the graph is two disconnected
+pairs — the cycle product has |lambda_2| = 1 and the spectral gap is
+exactly zero.  This is the failure mode only the semantic engine can see.
+"""
+# EXPECT-MODULE: SGPV103
+
+from types import SimpleNamespace
+
+import numpy as np
+
+_N = 4
+_DISCONNECTED = np.array([[[1, 0, 3, 2]]], dtype=np.int32)
+
+SGPLINT_SCHEDULES = [
+    SimpleNamespace(
+        perms=_DISCONNECTED,
+        self_weight=np.full((1, _N), 0.5),
+        edge_weights=np.full((1, 1, _N), 0.5),
+        num_phases=1, world_size=_N, peers_per_itr=1),
+]

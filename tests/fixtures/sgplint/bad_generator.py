@@ -1,0 +1,18 @@
+"""SGPV105: a schedule generator that crashes instead of refusing."""
+# EXPECT-MODULE: SGPV105
+
+
+class _ExplodingGraph:
+    world_size = 4
+    peers_per_itr = 1
+
+    @property
+    def num_phases(self):
+        raise RuntimeError("phase table exploded")
+
+    @property
+    def all_phase_permutations(self):
+        raise RuntimeError("phase table exploded")
+
+
+SGPLINT_TOPOLOGIES = [_ExplodingGraph()]

@@ -1,0 +1,20 @@
+"""SGPL008: global-state mutation inside traced code."""
+
+import jax
+import jax.numpy as jnp
+
+_STEP_COUNT = 0
+
+
+@jax.jit
+def counting_step(x):
+    global _STEP_COUNT  # EXPECT: SGPL008
+    _STEP_COUNT = _STEP_COUNT + 1
+    return x * 2.0
+
+
+def host_counter():
+    # NOT traced: host-side global bookkeeping is fine
+    global _STEP_COUNT
+    _STEP_COUNT += 1
+    return _STEP_COUNT

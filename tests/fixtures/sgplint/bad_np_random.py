@@ -1,0 +1,16 @@
+"""SGPL003: numpy RNG frozen into a traced program."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_dropout(x):
+    mask = np.random.rand(*x.shape) > 0.5  # EXPECT: SGPL003
+    noise = np.random.normal(size=x.shape)  # EXPECT: SGPL003
+    return x * mask + noise
+
+
+def host_shuffle(idx):
+    # NOT traced: numpy RNG on the host is fine
+    return np.random.permutation(idx)

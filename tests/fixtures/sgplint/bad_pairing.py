@@ -1,0 +1,11 @@
+"""SGPV104: bilateral pairings that would deadlock the exchange."""
+# EXPECT-MODULE: SGPV104,SGPV104
+
+import numpy as np
+
+SGPLINT_PAIRINGS = [
+    # 3-cycle 0->1->2->0: not an involution
+    np.array([[1, 2, 0, 3]], dtype=np.int32),
+    # rank 0 paired with itself: fixed point
+    np.array([[0, 1, 3, 2]], dtype=np.int32),
+]

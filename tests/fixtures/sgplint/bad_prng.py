@@ -1,0 +1,27 @@
+"""SGPL005: PRNG key reuse without split/fold_in."""
+
+import jax
+import jax.numpy as jnp
+
+
+def correlated_noise(seed, shape):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # EXPECT: SGPL005
+    return a + b
+
+
+def fresh_keys_ok(seed, shape):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def refreshed_ok(seed, shape):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, shape)
+    key = jax.random.fold_in(key, 1)
+    b = jax.random.normal(key, shape)
+    return a + b

@@ -1,0 +1,31 @@
+"""SGPV101/SGPV102: malformed schedule tables.
+
+Two schedule-like objects: one whose sub-round sends two sources to the
+same destination (ppermute would drop a message), one whose mixing
+columns sum to 1.1 (push-sum mass inflates every round).
+"""
+# EXPECT-MODULE: SGPV101,SGPV102
+
+from types import SimpleNamespace
+
+import numpy as np
+
+_N = 4
+
+_NOT_A_PERMUTATION = np.array([[[2, 2, 3, 0]]], dtype=np.int32)
+_RING = np.array([[[1, 2, 3, 0]]], dtype=np.int32)
+
+SGPLINT_SCHEDULES = [
+    # ranks 0 and 1 both send to rank 2 -> SGPV101
+    SimpleNamespace(
+        perms=_NOT_A_PERMUTATION,
+        self_weight=np.full((1, _N), 0.5),
+        edge_weights=np.full((1, 1, _N), 0.5),
+        num_phases=1, world_size=_N, peers_per_itr=1),
+    # valid ring, but columns sum to 1.1 -> SGPV102
+    SimpleNamespace(
+        perms=_RING,
+        self_weight=np.full((1, _N), 0.6),
+        edge_weights=np.full((1, 1, _N), 0.5),
+        num_phases=1, world_size=_N, peers_per_itr=1),
+]

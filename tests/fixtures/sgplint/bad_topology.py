@@ -1,0 +1,16 @@
+"""SGPV101 via the topology protocol: a generator that emits a corrupt
+permutation table without noticing (bypasses graphs.py's own build-time
+check, which is exactly the hole the verifier closes)."""
+# EXPECT-MODULE: SGPV101
+
+from stochastic_gradient_push_tpu.topology.graphs import RingGraph
+
+
+class BrokenRing(RingGraph):
+    def phase_permutation(self, phase):
+        perm = super().phase_permutation(phase).copy()
+        perm[..., 0] = perm[..., 1]  # sources 0 and 1 share a destination
+        return perm
+
+
+SGPLINT_TOPOLOGIES = [BrokenRing(8)]

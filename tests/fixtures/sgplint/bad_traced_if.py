@@ -1,0 +1,18 @@
+"""SGPL004: Python control flow on traced values."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def unstageable(x):
+    if jnp.any(x > 0):  # EXPECT: SGPL004
+        x = x + 1.0
+    while jnp.abs(x).max() > 1.0:  # EXPECT: SGPL004
+        x = x * 0.5
+    if (lax.psum(x, "gossip") > 0).all():  # EXPECT: SGPL004
+        x = -x
+    if x.ndim == 2:  # shape is static: silent
+        x = x[None]
+    return x

@@ -1,0 +1,43 @@
+"""Known-clean fixture: every rule's correct counterpart in one module.
+
+Both engines must stay silent here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stochastic_gradient_push_tpu.topology.graphs import RingGraph
+
+PAIRS = [(0, 1), (1, 0)]
+
+
+@jax.jit
+def good_step(x, key):
+    # collective over a declared axis, tracing-safe logging, staged branch
+    y = lax.pmean(x, "gossip")
+    jax.debug.print("mean={m}", m=y.sum())
+    y = jnp.where(jnp.any(y > 0), y + 1.0, y)
+    k1, k2 = jax.random.split(key)
+    noise = jax.random.normal(k1, y.shape)
+    scale = jax.random.uniform(k2, ())
+    return y + noise * scale
+
+
+def host_loop(path, state, batch):
+    # host side: effects, numpy RNG, narrow excepts are all fine here
+    print("starting epoch")
+    perm = np.random.permutation(len(batch))
+    try:
+        ckpt = open(path).read()
+    except OSError:
+        ckpt = None
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    state = step(state, batch[perm[0]])
+    return state, ckpt
+
+
+# valid schedule material for the semantic engine
+SGPLINT_TOPOLOGIES = [RingGraph(8)]
+SGPLINT_PAIRINGS = [np.array([[1, 0, 3, 2]], dtype=np.int32)]
