@@ -1,7 +1,12 @@
 #!/usr/bin/env bash
-# Repo correctness gate: static analysis first (seconds), then tier-1
-# tests.  This is the command CI runs and the command to run before
-# pushing; both stages are CPU-only.
+# Repo correctness gate: static analysis first (seconds), then the
+# planner self-check, then tier-1 tests.  This is the command CI runs and
+# the command to run before pushing; all stages are CPU-only.
+#
+# The sgplint stage also emits the full spectral-gap grid as a JSON
+# artifact (artifacts/gap_report.json) so CI can diff mixing behavior
+# across PRs — a topology edit that silently moves a gap shows up as
+# artifact drift even when no rule fires.
 #
 # Usage: scripts/check.sh [extra pytest args...]
 
@@ -9,7 +14,11 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== sgplint (AST lint + schedule verifier) =="
-python scripts/sgplint.py --check
+python scripts/sgplint.py --check --report-json artifacts/gap_report.json
+
+echo
+echo "== planner self-check =="
+python scripts/plan.py --world 8 --selftest
 
 echo
 echo "== tier-1 tests (CPU, not slow) =="

@@ -64,6 +64,16 @@ class PushSumGossip(GossipAlgorithm):
     round just launched.  Memory cost: ``staleness`` extra parameter
     copies.  Every launched share is consumed exactly once, so push-sum
     mass conservation is preserved for any staleness.
+
+    ``global_avg_every`` interleaves an *exact* global average every k-th
+    step (periodic global averaging, Chen et al.): after the gossip
+    round, ``x ← Σ x / Σ w`` via one allreduce and the push-sum weight
+    resets to 1.  The consensus value of push-sum is exactly that ratio,
+    so the operation preserves the mean for any mixing (uniform or
+    irregular) while snapping all ranks to consensus — the planner's
+    recovery for topologies whose spectral gap is below the floor at the
+    requested world size.  Synchronous mode only (an in-flight overlap
+    share would be double-counted by the average).
     """
 
     name = "sgp"
@@ -71,7 +81,7 @@ class PushSumGossip(GossipAlgorithm):
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, track_weight: bool = True,
                  gossip_every: int = 1, comm_dtype=None,
-                 staleness: int = 1):
+                 staleness: int = 1, global_avg_every: int = 0):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
@@ -92,6 +102,15 @@ class PushSumGossip(GossipAlgorithm):
                 "gossip_every > 1 is a synchronous-mode knob; overlap "
                 "already hides the collective behind compute")
         self.gossip_every = gossip_every
+        # periodic exact global averaging every k-th step (0 = off);
+        # see the class docstring
+        if global_avg_every < 0:
+            raise ValueError("global_avg_every must be >= 0")
+        if global_avg_every and overlap:
+            raise ValueError(
+                "global_avg_every is a synchronous-mode knob: averaging "
+                "around in-flight overlap shares would double-count them")
+        self.global_avg_every = global_avg_every
         # wire-compression dtype for gossip payloads (e.g. jnp.bfloat16)
         self.comm_dtype = comm_dtype
 
@@ -199,6 +218,8 @@ class PushSumGossip(GossipAlgorithm):
             params, ps_weight = self._mix(params, state.ps_weight, phase)
             ps_weight = jnp.reshape(jnp.asarray(ps_weight, jnp.float32),
                                     jnp.shape(state.ps_weight))
+            params, ps_weight = self._maybe_global_average(
+                params, ps_weight, phase + 1)
             return params, state.replace(phase=phase + 1,
                                          ps_weight=ps_weight)
         # overlap: keep local share now, stash incoming for next pre_step
@@ -223,8 +244,30 @@ class PushSumGossip(GossipAlgorithm):
 
         params, ps_weight = jax.lax.cond(
             fire, mix_branch, lambda o: o, (params, state.ps_weight))
+        params, ps_weight = self._maybe_global_average(
+            params, ps_weight, tick + 1)
         return params, state.replace(phase=state.phase + 1,
                                      ps_weight=ps_weight)
+
+    def _maybe_global_average(self, params, ps_weight, tick_next):
+        """Every ``global_avg_every`` steps: snap to the exact push-sum
+        consensus ``Σ params / Σ ps_weight`` and reset the weight to 1.
+        Mass conservation makes that ratio the true parameter average
+        under any column-stochastic mixing, so the trajectory mean is
+        untouched while consensus error drops to zero."""
+        if self.global_avg_every <= 0:
+            return params, ps_weight
+        fire = (as_scalar(tick_next) % self.global_avg_every) == 0
+
+        def avg_branch(operand):
+            p, w = operand
+            tot_p, tot_w = collectives.allreduce_sum((p, w), self.axis_name)
+            tw = as_scalar(tot_w)
+            p = jax.tree.map(lambda a: (a / tw.astype(a.dtype)), tot_p)
+            return p, jnp.ones_like(w)
+
+        return jax.lax.cond(fire, avg_branch, lambda o: o,
+                            (params, ps_weight))
 
     def _finish_overlap(self, local_p, local_w, incoming, state, phase):
         local_w = jnp.reshape(jnp.asarray(local_w, jnp.float32),
@@ -252,12 +295,14 @@ class PushPullGossip(PushSumGossip):
     name = "dpsgd"
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
-                 overlap: bool = False, staleness: int = 1):
+                 overlap: bool = False, staleness: int = 1,
+                 global_avg_every: int = 0):
         if not schedule.regular:
             raise ValueError("D-PSGD requires a regular schedule "
                              "(doubly-stochastic mixing)")
         super().__init__(schedule, axis_name, overlap=overlap,
-                         track_weight=overlap, staleness=staleness)
+                         track_weight=overlap, staleness=staleness,
+                         global_avg_every=global_avg_every)
 
 
 class BilateralGossip(GossipAlgorithm):
@@ -293,10 +338,12 @@ def all_reduce(axis_name: str) -> AllReduce:
 
 def sgp(schedule: GossipSchedule, axis_name: str,
         overlap: bool = False, gossip_every: int = 1,
-        comm_dtype=None, staleness: int = 1) -> PushSumGossip:
+        comm_dtype=None, staleness: int = 1,
+        global_avg_every: int = 0) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
                          gossip_every=gossip_every, comm_dtype=comm_dtype,
-                         staleness=staleness)
+                         staleness=staleness,
+                         global_avg_every=global_avg_every)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str,
@@ -306,9 +353,11 @@ def osgp(schedule: GossipSchedule, axis_name: str,
 
 
 def dpsgd(schedule: GossipSchedule, axis_name: str,
-          overlap: bool = False, staleness: int = 1) -> PushPullGossip:
+          overlap: bool = False, staleness: int = 1,
+          global_avg_every: int = 0) -> PushPullGossip:
     return PushPullGossip(schedule, axis_name, overlap=overlap,
-                          staleness=staleness)
+                          staleness=staleness,
+                          global_avg_every=global_avg_every)
 
 
 def adpsgd(pairing: np.ndarray, axis_name: str) -> BilateralGossip:

@@ -28,6 +28,9 @@ from .verifier import (
     verify_module,
     verify_schedule,
     verify_pairing,
+    spectral_gap,
+    GapEntry,
+    is_unsupported_config,
     DEFAULT_WORLD_SIZES,
 )
 
@@ -42,5 +45,12 @@ __all__ = [
     "verify_module",
     "verify_schedule",
     "verify_pairing",
+    # stable public API: the rotation-cycle spectral-gap power-of-products
+    # computation, its report-row type, and the unsupported-configuration
+    # predicate.  The planner (planner/scorer.py) builds on these instead
+    # of duplicating the eigenvalue machinery or the skip rules.
+    "spectral_gap",
+    "GapEntry",
+    "is_unsupported_config",
     "DEFAULT_WORLD_SIZES",
 ]

@@ -37,7 +37,7 @@ def package_dir() -> str:
 
 
 def run_full(baseline_path: str, update: bool, report: bool,
-             quiet: bool = False) -> int:
+             quiet: bool = False, report_json: str | None = None) -> int:
     root = repo_root()
     findings = lint_paths([package_dir()], relto=root)
     sem, gaps = verify_package(relto=root)
@@ -45,6 +45,9 @@ def run_full(baseline_path: str, update: bool, report: bool,
 
     baseline = load_baseline(baseline_path)
     new, old = partition_against_baseline(findings, baseline)
+
+    if report_json:
+        _write_gap_report(report_json, gaps)
 
     if update:
         save_baseline(baseline_path, findings)
@@ -74,6 +77,23 @@ def run_full(baseline_path: str, update: bool, report: bool,
     print(f"sgplint: clean ({len(old)} baselined, "
           f"{len(gaps)} schedule configurations verified)", file=out)
     return 0
+
+
+def _write_gap_report(path: str, gaps) -> None:
+    """Dump the full spectral-gap grid as a JSON artifact so CI can track
+    gap drift across PRs (sorted for stable diffs)."""
+    import json
+
+    rows = [{"topology": g.topology, "world": g.world, "ppi": g.ppi,
+             "mixing": g.mixing, "gap": round(float(g.gap), 9)}
+            for g in sorted(gaps)]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"configurations": len(rows), "gaps": rows}, f,
+                  indent=1, sort_keys=True)
+        f.write("\n")
 
 
 def run_files(files: list[str]) -> int:
@@ -119,6 +139,9 @@ def main(argv=None) -> int:
                          f"{DEFAULT_BASELINE})")
     ap.add_argument("--report", action="store_true",
                     help="print the spectral-gap report")
+    ap.add_argument("--report-json", default=None, metavar="PATH",
+                    help="write the full spectral-gap grid as a JSON "
+                         "artifact (CI gap-drift tracking)")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -133,7 +156,7 @@ def main(argv=None) -> int:
 
     baseline = args.baseline or os.path.join(repo_root(), DEFAULT_BASELINE)
     return run_full(baseline, update=args.update_baseline,
-                    report=args.report)
+                    report=args.report, report_json=args.report_json)
 
 
 def console_main() -> int:

@@ -34,7 +34,7 @@ from .findings import Finding
 
 __all__ = ["verify_schedule", "verify_pairing", "verify_topology",
            "verify_module", "verify_package", "DEFAULT_WORLD_SIZES",
-           "GapEntry"]
+           "GapEntry", "is_unsupported_config"]
 
 # 2..64 per the convergence-grid contract: powers of two (pod slices),
 # odd/even non-powers (the shapes that break naive schedules)
@@ -158,13 +158,17 @@ def verify_pairing(pairing: np.ndarray, label: str, file: str, line: int
     return findings
 
 
-def _is_unsupported(err: ValueError) -> bool:
+def is_unsupported_config(err: ValueError) -> bool:
     """Constructor refusals that mean 'configuration unsupported', not
-    'generator broken'."""
+    'generator broken'.  Public: the planner uses the same predicate so
+    it skips exactly the cells the verifier skips."""
     msg = str(err)
     needles = ("unsupported", "even world size", "exceeds phone-book",
                "no hop distance", "requires an even", "must be >=")
     return any(s in msg for s in needles)
+
+
+_is_unsupported = is_unsupported_config
 
 
 def _mixing_grid(world: int):

@@ -1,0 +1,57 @@
+"""Launch-time topology & mixing-schedule planner.
+
+The analysis layer (sgplint Engine 2) made gossip mixing *measurable*:
+every registered topology's rotation-cycle spectral gap is computed on CPU
+in milliseconds.  This package makes it *actionable* at launch:
+
+* :mod:`.scorer` — enumerate and rank every (topology × peers_per_itr)
+  candidate for a world size by gap and a per-phase communication-cost
+  model;
+* :mod:`.alpha` — co-optimize the SelfWeightedMixing alpha against the
+  chosen topology (a small scalar search) instead of taking it as a free
+  knob;
+* :mod:`.policy` — the decision layer: ``plan_for`` auto-switches away
+  from below-floor topologies and emits a periodic-global-averaging
+  schedule when no pure-gossip candidate clears the floor;
+  ``check_topology`` scores user-forced choices and attaches loud
+  structured warnings; ``resolve_topology`` is the run layer's single
+  entry point (``--topology auto``);
+* :mod:`.cli` — ``scripts/plan.py``: ranked tables for offline capacity
+  planning plus the CI self-check.
+
+Everything is plain numpy over small matrices — no devices, no tracing —
+so planning is free at launch and the CLI runs anywhere.
+"""
+
+from .alpha import alpha_gap, optimize_alpha
+from .policy import (
+    DEFAULT_GAP_FLOOR,
+    Plan,
+    PlanConstraints,
+    check_topology,
+    plan_for,
+    resolve_topology,
+)
+from .scorer import (
+    Candidate,
+    DEFAULT_PEER_COUNTS,
+    consensus_cost,
+    evaluate_candidate,
+    score_candidates,
+)
+
+__all__ = [
+    "DEFAULT_GAP_FLOOR",
+    "DEFAULT_PEER_COUNTS",
+    "Candidate",
+    "Plan",
+    "PlanConstraints",
+    "alpha_gap",
+    "check_topology",
+    "consensus_cost",
+    "evaluate_candidate",
+    "optimize_alpha",
+    "plan_for",
+    "resolve_topology",
+    "score_candidates",
+]

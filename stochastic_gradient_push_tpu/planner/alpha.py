@@ -1,0 +1,74 @@
+"""Co-optimization of the SelfWeightedMixing alpha against a topology.
+
+The ROADMAP's verifier finding: per-rank irregular mixing costs up to 28%
+of the spectral gap at world 64 (NPeerExponential ppi 4: uniform 0.976 vs
+0.712 at the default alpha 0.5).  The cause is structural — alpha is the
+self-mass a rank keeps per round, so the gap-optimal value tracks the
+graph's out-degree (uniform mixing keeps ``1/(deg+1)``), while the default
+0.5 is only right for degree 1.  Treating alpha as a free knob therefore
+silently throws away mixing speed on any multi-peer topology.
+
+``optimize_alpha`` replaces the free knob with a small scalar search:
+coarse grid to localize the basin (the gap is smooth but not guaranteed
+unimodal in alpha across phase products), then golden-section refinement
+inside the bracketing interval.  Each evaluation is one schedule build
+plus one ``world × world`` cycle-product eigensolve — a few milliseconds
+at pod scale, so the whole search costs well under a second.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..analysis import spectral_gap
+from ..topology import build_schedule
+from ..topology.mixing import SelfWeightedMixing
+
+__all__ = ["alpha_gap", "optimize_alpha"]
+
+_GOLDEN = (math.sqrt(5.0) - 1.0) / 2.0
+
+
+def alpha_gap(graph, alpha: float) -> float:
+    """Rotation-cycle spectral gap of ``graph`` under scalar
+    ``SelfWeightedMixing(alpha)``."""
+    return spectral_gap(build_schedule(graph, SelfWeightedMixing(alpha)))
+
+
+def optimize_alpha(graph, *, lo: float = 0.02, hi: float = 0.98,
+                   coarse: int = 13, iters: int = 20
+                   ) -> tuple[float, float]:
+    """Maximize the spectral gap over scalar alpha ∈ (lo, hi).
+
+    Returns ``(alpha, gap)`` at the optimum found.  ``coarse`` grid points
+    localize the best basin; ``iters`` golden-section steps shrink the
+    bracket below 1e-4, far tighter than the gap's sensitivity to alpha.
+    """
+    if not 0.0 < lo < hi < 1.0:
+        raise ValueError("need 0 < lo < hi < 1")
+    grid = np.linspace(lo, hi, coarse)
+    gaps = [alpha_gap(graph, float(a)) for a in grid]
+    i = int(np.argmax(gaps))
+    a, b = float(grid[max(i - 1, 0)]), float(grid[min(i + 1, coarse - 1)])
+
+    # golden-section on [a, b]; track the best point ever evaluated so a
+    # non-unimodal wrinkle can only cost refinement, never the basin
+    best_a, best_g = float(grid[i]), float(gaps[i])
+    x1 = b - _GOLDEN * (b - a)
+    x2 = a + _GOLDEN * (b - a)
+    g1, g2 = alpha_gap(graph, x1), alpha_gap(graph, x2)
+    for _ in range(iters):
+        if g1 >= g2:
+            b, x2, g2 = x2, x1, g1
+            x1 = b - _GOLDEN * (b - a)
+            g1 = alpha_gap(graph, x1)
+        else:
+            a, x1, g1 = x1, x2, g2
+            x2 = a + _GOLDEN * (b - a)
+            g2 = alpha_gap(graph, x2)
+        for x, g in ((x1, g1), (x2, g2)):
+            if g > best_g:
+                best_a, best_g = x, g
+    return best_a, best_g

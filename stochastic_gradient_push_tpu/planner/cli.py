@@ -1,0 +1,161 @@
+"""``scripts/plan.py`` driver — offline capacity planning and CI self-check.
+
+Modes:
+
+* default — print the chosen plan (topology, gap, mixing, averaging
+  period, rationale) for ``--world``/``--ppi``;
+* ``--topology NAME`` — score a forced topology instead of planning,
+  surfacing the below-floor warning exactly as the run layer would;
+* ``--report`` — print the full ranked candidate table;
+* ``--json PATH`` — also dump the plan as JSON (``-`` = stdout);
+* ``--selftest`` — cheap invariant checks for CI (scripts/check.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .policy import (
+    DEFAULT_GAP_FLOOR,
+    PlanConstraints,
+    check_topology,
+    plan_for,
+)
+from .scorer import DEFAULT_PEER_COUNTS, score_candidates
+
+
+def _print_table(cands, floor: float) -> None:
+    print(f"{'topology':<24} {'ppi':>3} {'gap':>8} {'phases':>6} "
+          f"{'msgs/efold':>10}  floor")
+    for c in cands:
+        cost = f"{c.comm_cost:10.1f}" if c.comm_cost != float("inf") \
+            else f"{'inf':>10}"
+        mark = "ok" if c.meets(floor) else "BELOW"
+        print(f"{c.topology:<24} {c.ppi:>3} {c.gap:>8.4f} "
+              f"{c.num_phases:>6} {cost}  {mark}")
+
+
+def _selftest(world: int, floor: float) -> int:
+    """Planner invariants the CI gate pins on every run."""
+    from ..topology import (NPeerDynamicDirectedExponentialGraph, RingGraph,
+                            topology_name)
+    from .alpha import alpha_gap, optimize_alpha
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    cands = score_candidates(world)
+    check(len(cands) > 0, f"no candidates scored at world {world}")
+    check(all(0.0 <= c.gap <= 1.0 + 1e-9 for c in cands),
+          "candidate gap outside [0, 1]")
+
+    plan = plan_for(world, ppi=1)
+    check(plan.gap >= floor or plan.global_avg_every > 0,
+          f"plan at world {world} neither clears the floor nor schedules "
+          "global averaging")
+    check(json.loads(json.dumps(plan.to_dict()))["topology"]
+          == plan.topology, "plan dict does not round-trip through JSON")
+
+    # the pod-scale policy decisions the subsystem exists for:
+    big = plan_for(64, ppi=1)
+    check(big.topology != "ring" and big.gap >= floor,
+          f"world-64 plan did not avoid the ring (got {big.summary()})")
+    forced = check_topology(64, RingGraph, ppi=1, floor=floor)
+    check(forced.below_floor() and forced.warnings
+          and forced.global_avg_every > 0,
+          "forced ring at world 64 did not produce the below-floor "
+          "warning + averaging period")
+
+    # alpha co-optimization must never do worse than the default knob
+    g = NPeerDynamicDirectedExponentialGraph(world, peers_per_itr=2)
+    tuned_alpha, tuned_gap = optimize_alpha(g)
+    check(tuned_gap + 1e-9 >= alpha_gap(g, 0.5),
+          f"optimize_alpha regressed below the default on "
+          f"{topology_name(type(g))}")
+    check(0.0 < tuned_alpha < 1.0, "optimized alpha outside (0, 1)")
+
+    if failures:
+        for f in failures:
+            print(f"planner selftest FAILED: {f}", file=sys.stderr)
+        return 1
+    print(f"planner selftest: OK ({len(cands)} candidates at world "
+          f"{world}; world-64 plan = {big.topology}, gap {big.gap:.4f})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="plan",
+        description="Launch-time gossip topology & mixing planner")
+    ap.add_argument("--world", type=int, required=True,
+                    help="gossip world size (ranks on the gossip axis)")
+    ap.add_argument("--ppi", type=int, default=1,
+                    help="peers per iteration (0 = search "
+                         f"{DEFAULT_PEER_COUNTS})")
+    ap.add_argument("--algorithm", default="sgp",
+                    choices=["sgp", "dpsgd"])
+    ap.add_argument("--floor", type=float, default=DEFAULT_GAP_FLOOR,
+                    help="minimum acceptable rotation-cycle spectral gap")
+    ap.add_argument("--topology", default=None,
+                    help="score this forced topology instead of planning")
+    ap.add_argument("--self-weighted", action="store_true",
+                    help="co-optimize a SelfWeightedMixing alpha against "
+                         "the chosen topology")
+    ap.add_argument("--report", action="store_true",
+                    help="print the full ranked candidate table")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="dump the plan as JSON ('-' = stdout)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the CI self-check and exit")
+    args = ap.parse_args(argv)
+
+    if args.world < 1:
+        ap.error("--world must be >= 1")
+    if args.selftest:
+        return _selftest(args.world, args.floor)
+
+    ppi = args.ppi if args.ppi else None
+    try:
+        if args.topology:
+            from ..topology import TOPOLOGY_NAMES
+            if args.topology not in TOPOLOGY_NAMES:
+                ap.error(f"unknown topology {args.topology!r}; one of "
+                         f"{sorted(TOPOLOGY_NAMES)}")
+            plan = check_topology(
+                args.world, TOPOLOGY_NAMES[args.topology],
+                ppi=ppi or 1, algorithm=args.algorithm, floor=args.floor,
+                self_weighted=args.self_weighted)
+        else:
+            plan = plan_for(args.world, ppi=ppi, algorithm=args.algorithm,
+                            constraints=PlanConstraints(
+                                floor=args.floor,
+                                self_weighted=args.self_weighted))
+    except ValueError as e:
+        print(f"plan: error: {e}", file=sys.stderr)
+        return 2
+
+    print(f"plan for world={args.world} algorithm={args.algorithm} "
+          f"floor={args.floor}:")
+    print(f"  {plan.summary()}")
+    print(f"  rationale: {plan.rationale}")
+    for w in plan.warnings:
+        print(f"  WARNING: {w}")
+    if args.report:
+        print()
+        cands = score_candidates(
+            args.world, (ppi,) if ppi else DEFAULT_PEER_COUNTS,
+            floor=args.floor)
+        _print_table(cands, args.floor)
+    if args.json:
+        payload = json.dumps(plan.to_dict(), indent=2, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    return 0 if not plan.warnings else 3

@@ -1,0 +1,164 @@
+"""Topology scoring: enumerate, measure, and rank gossip graphs at launch.
+
+SGP's convergence rate degrades as ``1/gap`` of the mixing matrix (Assran
+et al. 2018, thm. 1), and the gap is a *launch-time computable* property:
+every registered :class:`~..topology.graphs.GraphTopology` compiles to a
+finite rotation cycle of column-stochastic matrices whose product's
+second-largest eigenvalue modulus is known before the first training step.
+This module turns that observation into a ranking:
+
+* **gap** — rotation-cycle spectral gap ``1 − |λ₂|``, computed by the
+  analysis layer's :func:`~..analysis.spectral_gap` (public API; the
+  planner deliberately does not duplicate the power-of-products
+  eigenvalue machinery the verifier already owns);
+* **consensus cost** — a per-phase communication model: a cycle of
+  ``num_phases`` phases contracts consensus error by ``|λ₂|``, so one
+  e-fold of error reduction costs ``num_phases / −ln|λ₂|`` gossip rounds,
+  each round sending ``peers_per_itr`` messages per rank.  Exact-consensus
+  cycles (gap 1.0, e.g. DynamicBipartiteLinearGraph at even worlds) cost
+  exactly one cycle.
+
+Ranking prefers candidates that clear the gap floor, then the cheapest
+consensus, then the largest gap — so a slow-but-connected ring never
+outranks an exponential graph, and among perfect mixers the one with the
+shortest cycle wins.
+
+Everything here is plain numpy over small ``world × world`` matrices; the
+full candidate grid for a 64-rank pod scores in well under a second on one
+CPU core, which is what makes launch-time planning free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+# shared with the verifier (stable exports) so the planner and the CI
+# gate measure gaps identically and skip the exact same cells
+from ..analysis import is_unsupported_config, spectral_gap
+from ..topology import TOPOLOGY_NAMES, build_schedule, topology_name
+from ..topology.mixing import MixingStrategy, SelfWeightedMixing, UniformMixing
+
+__all__ = [
+    "Candidate",
+    "DEFAULT_GAP_FLOOR",
+    "DEFAULT_PEER_COUNTS",
+    "consensus_cost",
+    "evaluate_candidate",
+    "score_candidates",
+]
+
+# gap below which a topology is considered effectively non-mixing at the
+# requested world size — the ring-at-pod-scale failure mode (gap 0.0012 at
+# world 64 means ~830 gossip rounds per e-fold of consensus error)
+DEFAULT_GAP_FLOOR = 0.01
+
+DEFAULT_PEER_COUNTS = (1, 2, 4)
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One scored (topology, world, peers_per_itr, mixing) cell."""
+
+    topology: str            # name from topology.TOPOLOGY_NAMES
+    world: int
+    ppi: int
+    mixing: str              # "uniform" or "self-weighted(<alpha>)"
+    alpha: float | None      # scalar SelfWeightedMixing alpha, if any
+    gap: float               # rotation-cycle spectral gap 1 - |λ₂|
+    num_phases: int          # rotation phases per cycle
+    rounds_per_efold: float  # gossip rounds per e-fold of consensus error
+    comm_cost: float         # messages per rank per e-fold (rounds × ppi)
+
+    @property
+    def graph_class(self):
+        return TOPOLOGY_NAMES[self.topology]
+
+    def meets(self, floor: float) -> bool:
+        return self.gap >= floor
+
+    def to_dict(self) -> dict:
+        """JSON-safe summary (checkpoint metadata / report artifacts)."""
+        d = dataclasses.asdict(self)
+        d["comm_cost"] = round(self.comm_cost, 3) \
+            if math.isfinite(self.comm_cost) else None
+        d["rounds_per_efold"] = round(self.rounds_per_efold, 3) \
+            if math.isfinite(self.rounds_per_efold) else None
+        return d
+
+
+def consensus_cost(gap: float, num_phases: int, ppi: int
+                   ) -> tuple[float, float]:
+    """(gossip rounds, messages per rank) for one e-fold of consensus
+    error, under the per-cycle contraction model described in the module
+    docstring."""
+    if gap >= 1.0 - 1e-9:
+        rounds = float(num_phases)  # exact consensus after one full cycle
+    elif gap <= 0.0:
+        rounds = math.inf           # cycle does not contract
+    else:
+        rounds = num_phases / -math.log1p(-gap)
+    return rounds, rounds * ppi
+
+
+def evaluate_candidate(graph_class, world: int, ppi: int,
+                       mixing: MixingStrategy | None = None
+                       ) -> Candidate | None:
+    """Score one cell; ``None`` when the generator refuses the
+    configuration (odd world for a bipartite graph, ppi beyond the phone
+    book, ...)."""
+    try:
+        graph = graph_class(world, peers_per_itr=ppi)
+        schedule = build_schedule(graph, mixing)
+    except ValueError as e:
+        if is_unsupported_config(e):
+            return None
+        raise
+    gap = spectral_gap(schedule)
+    rounds, cost = consensus_cost(gap, schedule.num_phases, ppi)
+    alpha = None
+    mix_name = "uniform"
+    if isinstance(mixing, SelfWeightedMixing):
+        if mixing.alpha.size != 1:
+            raise ValueError("planner scores scalar alphas only; per-rank "
+                             "alpha tables are a run-layer concern")
+        alpha = float(mixing.alpha[0])
+        mix_name = f"self-weighted({alpha:.4f})"
+    return Candidate(topology=topology_name(graph_class), world=world,
+                     ppi=ppi, mixing=mix_name, alpha=alpha, gap=gap,
+                     num_phases=schedule.num_phases,
+                     rounds_per_efold=rounds, comm_cost=cost)
+
+
+def score_candidates(world: int,
+                     peer_counts=DEFAULT_PEER_COUNTS,
+                     floor: float = DEFAULT_GAP_FLOOR,
+                     allowed=None) -> list[Candidate]:
+    """Rank every supported (topology × peers_per_itr) cell for ``world``
+    under uniform mixing.
+
+    Args:
+      world: gossip world size to plan for.
+      peer_counts: peers_per_itr values to consider.
+      floor: the gap floor used for ranking (floor-clearing candidates
+        always outrank the rest).
+      allowed: optional iterable of topology names restricting the search.
+
+    Returns candidates sorted best-first: clears-the-floor, then cheapest
+    consensus, then largest gap, then (name, ppi) for determinism.
+    """
+    names = sorted(TOPOLOGY_NAMES) if allowed is None else sorted(allowed)
+    unknown = [n for n in names if n not in TOPOLOGY_NAMES]
+    if unknown:
+        raise ValueError(f"unknown topology name(s) {unknown}; registered: "
+                         f"{sorted(TOPOLOGY_NAMES)}")
+    cands = []
+    for name in names:
+        for ppi in peer_counts:
+            c = evaluate_candidate(TOPOLOGY_NAMES[name], world, ppi,
+                                   UniformMixing())
+            if c is not None:
+                cands.append(c)
+    cands.sort(key=lambda c: (not c.meets(floor), c.comm_cost, -c.gap,
+                              c.topology, c.ppi))
+    return cands

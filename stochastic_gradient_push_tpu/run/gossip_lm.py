@@ -23,7 +23,7 @@ import json
 import os
 import time
 
-from ..topology import GRAPH_TOPOLOGIES
+from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
 
 __all__ = ["main", "build_parser"]
 
@@ -39,6 +39,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(synchronous formulation; see algorithms.py)")
     p.add_argument("--graph_type", default=5, type=int,
                    choices=list(GRAPH_TOPOLOGIES))
+    p.add_argument("--topology", default=None,
+                   choices=["auto"] + sorted(TOPOLOGY_NAMES),
+                   help="named topology: 'auto' lets the planner pick "
+                        "the gossip graph for the replica count; a name "
+                        "forces it (overriding --graph_type) with a "
+                        "below-floor warning when its gap is too small")
+    p.add_argument("--gap_floor", default=0.01, type=float,
+                   help="minimum acceptable rotation-cycle spectral gap "
+                        "for the gossip graph (planner policy)")
+    p.add_argument("--global_avg_every", default=None, type=int,
+                   help="exact global average every k steps; unset = "
+                        "the planner decides (enabled when no gossip "
+                        "graph clears the gap floor), 0 = explicitly "
+                        "off, k = force every-k averaging")
     p.add_argument("--peers_per_itr", default=1, type=int)
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step (communication thinning)")
@@ -245,6 +259,30 @@ def main(argv=None):
     dp = world // (sp * tp * ep * pp)
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
+
+    # launch-time topology policy BEFORE any mesh/device work (planning is
+    # pure numpy, and a below-floor warning must reach the user even when
+    # the launch subsequently fails): the gossip world for the LM is the
+    # data-parallel replica count, not raw devices
+    plan = None
+    if not sb(args.all_reduce) and not sb(args.bilat) and dp > 1:
+        from ..planner import resolve_topology
+
+        plan = resolve_topology(
+            dp, ppi=args.peers_per_itr, topology=args.topology,
+            graph_class=GRAPH_TOPOLOGIES[args.graph_type],
+            floor=args.gap_floor,
+            algorithm="sgp" if sb(args.push_sum) else "dpsgd",
+            global_avg_every=args.global_avg_every,  # None = policy
+            log=log)
+    elif args.topology is not None and (sb(args.all_reduce)
+                                        or sb(args.bilat)):
+        raise SystemExit("--topology selects a push-sum/D-PSGD gossip "
+                         "graph; it does not apply to all_reduce/bilat "
+                         "modes")
+    elif args.topology == "auto":
+        raise SystemExit("--topology auto plans gossip schedules; it does "
+                         "not apply to a single-replica mesh")
     if pp > 1:
         from ..train.pp import (build_pp_train_step, init_pp_state,
                                 make_dp_pp_ep_mesh, make_dp_pp_ep_sp_mesh,
@@ -405,19 +443,29 @@ def main(argv=None):
             dp, peers_per_itr=args.peers_per_itr)
         alg = adpsgd(build_pairing_schedule(graph), GOSSIP_AXIS)
     else:
-        graph = GRAPH_TOPOLOGIES[args.graph_type](
-            dp, peers_per_itr=args.peers_per_itr)
-        schedule = build_schedule(graph)
+        if plan is not None:
+            graph_cls = plan.graph_class
+        elif args.topology:  # forced name on a dp==1 mesh (plan skipped)
+            graph_cls = TOPOLOGY_NAMES[args.topology]
+        else:
+            graph_cls = GRAPH_TOPOLOGIES[args.graph_type]
+        graph = graph_cls(dp, peers_per_itr=args.peers_per_itr)
+        schedule = build_schedule(
+            graph, plan.mixing_strategy() if plan is not None else None)
+        gae = plan.global_avg_every if plan is not None \
+            else (args.global_avg_every or 0)
         if sb(args.push_sum):
             comm_dtype = (jnp.bfloat16 if args.gossip_comm_dtype == "bf16"
                           else None)
             alg = sgp(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
-                      gossip_every=args.gossip_every, comm_dtype=comm_dtype)
+                      gossip_every=args.gossip_every, comm_dtype=comm_dtype,
+                      global_avg_every=gae)
         else:
             if args.gossip_every != 1 or args.gossip_comm_dtype:
                 raise SystemExit(
                     "gossip_every/gossip_comm_dtype are push-sum knobs")
-            alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap))
+            alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
+                        global_avg_every=gae)
 
     tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
              nesterov=sb(args.nesterov))
@@ -576,13 +624,18 @@ def main(argv=None):
                 "tokens_per_sec": 0.0, "already_complete": True}
 
     def save_ckpt(st, step):
+        meta = {"step": step}
+        if plan is not None:
+            # reproducibility: the launch-time topology plan rides with
+            # the state it shaped
+            meta["plan"] = plan.to_dict()
         if use_orbax:
             # orbax steps are keyed by id: pass the step explicitly (the
             # live sharded state on pods, host conversion single-process)
-            ckpt.save(st, {"step": step}, epoch_id=step)
+            ckpt.save(st, meta, epoch_id=step)
         else:
             ckpt.save(host_local_slice(st) if proc_count > 1 else st,
-                      {"step": step})
+                      meta)
 
     if args.corpus_file:
         from ..data.lm import load_corpus

@@ -25,7 +25,7 @@ import argparse
 import dataclasses
 import os
 
-from ..topology import GRAPH_TOPOLOGIES, MIXING_STRATEGIES
+from ..topology import GRAPH_TOPOLOGIES, MIXING_STRATEGIES, TOPOLOGY_NAMES
 
 __all__ = ["build_parser", "parse_config", "main"]
 
@@ -72,6 +72,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--push_sum", default="True", type=str)
     p.add_argument("--graph_type", default=5, type=int,
                    choices=list(GRAPH_TOPOLOGIES))
+    p.add_argument("--topology", default=None,
+                   choices=["auto"] + sorted(TOPOLOGY_NAMES),
+                   help="named topology selection: 'auto' lets the "
+                        "planner pick (and tune) the gossip graph for "
+                        "the world size; a name forces it (overriding "
+                        "--graph_type) with a below-floor warning when "
+                        "its spectral gap is too small")
+    p.add_argument("--gap_floor", default=0.01, type=float,
+                   help="minimum acceptable rotation-cycle spectral gap; "
+                        "below it the planner auto-switches (or warns "
+                        "when the topology is user-forced)")
+    p.add_argument("--global_avg_every", default=None, type=int,
+                   help="exact global average (one allreduce) every k "
+                        "steps; unset = the planner decides (it enables "
+                        "periodic averaging when no gossip graph clears "
+                        "the gap floor), 0 = explicitly off even below "
+                        "the floor, k = force every-k averaging")
+    p.add_argument("--mixing_alpha", default=None, type=str,
+                   help="SelfWeightedMixing self-mass: 'auto' co-"
+                        "optimizes alpha against the chosen topology "
+                        "(planner scalar search); a float in (0,1) "
+                        "forces it (with a warning when co-optimization "
+                        "would recover >10%% of the gap); unset = "
+                        "uniform mixing")
     p.add_argument("--mixing_strategy", default=0, type=int,
                    choices=list(MIXING_STRATEGIES))
     p.add_argument("--schedule", nargs="+", default=[30, 0.1, 60, 0.1, 80, 0.1],
@@ -191,8 +215,24 @@ def parse_config(argv=None):
     all_reduce = _str_bool(args.all_reduce)
     if all_reduce and args.graph_type != -1:
         raise SystemExit("--all_reduce True requires --graph_type -1")
-    if not all_reduce and GRAPH_TOPOLOGIES[args.graph_type] is None:
-        raise SystemExit("gossip training requires a graph_type >= 0")
+    if all_reduce and args.topology is not None:
+        raise SystemExit("--topology selects a gossip graph; it does not "
+                         "apply to --all_reduce True")
+    if not all_reduce and args.topology is None \
+            and GRAPH_TOPOLOGIES[args.graph_type] is None:
+        raise SystemExit("gossip training requires a graph_type >= 0 "
+                         "(or --topology)")
+    args.mixing_alpha = _parse_mixing_alpha(args.mixing_alpha)
+    if args.mixing_alpha is not None and (
+            all_reduce or not _str_bool(args.push_sum)):
+        raise SystemExit("--mixing_alpha needs push-sum gossip: AllReduce "
+                         "doesn't mix, and D-PSGD requires a regular "
+                         "(doubly-stochastic) schedule")
+    # a forced name overrides the integer registry; 'auto' is resolved in
+    # main() once the world size is known (planner.resolve_topology)
+    graph_class = GRAPH_TOPOLOGIES[args.graph_type]
+    if args.topology not in (None, "auto"):
+        graph_class = TOPOLOGY_NAMES[args.topology]
 
     cfg = TrainerConfig(
         all_reduce=all_reduce,
@@ -200,7 +240,7 @@ def parse_config(argv=None):
         overlap=_str_bool(args.overlap),
         synch_freq=args.synch_freq,
         bilat=getattr(args, "bilat", False),
-        graph_class=GRAPH_TOPOLOGIES[args.graph_type],
+        graph_class=graph_class,
         mixing_class=MIXING_STRATEGIES[args.mixing_strategy],
         ppi_schedule=ppi_schedule,
         lr=args.lr,
@@ -234,8 +274,65 @@ def parse_config(argv=None):
         gossip_comm_dtype=args.gossip_comm_dtype,
         per_rank_csv=_str_bool(args.per_rank_csv),
         heartbeat_timeout=args.heartbeat_timeout,
+        global_avg_every=args.global_avg_every or 0,
     )
     return cfg, args
+
+
+def _parse_mixing_alpha(v):
+    """--mixing_alpha: None, 'auto' (co-optimize), or a float in (0,1)."""
+    if v is None:
+        return None
+    if v == "auto":
+        return "auto"
+    try:
+        alpha = float(v)
+    except ValueError:
+        raise SystemExit(f"--mixing_alpha must be 'auto' or a float in "
+                         f"(0, 1), got {v!r}")
+    if not 0.0 < alpha < 1.0:
+        raise SystemExit(f"--mixing_alpha {alpha} outside (0, 1)")
+    return alpha
+
+
+def _resolve_plan(cfg, args, gossip_world: int, log):
+    """Apply the launch-time topology policy (planner/) to ``cfg``.
+
+    Auto mode picks (and tunes) the graph; forced mode measures the
+    user's choice and warns loudly when its gap is below the floor.  The
+    chosen plan is logged as one JSON line and stamped into ``cfg.plan``
+    (and from there into checkpoint metadata).
+    """
+    if cfg.all_reduce or cfg.bilat or cfg.bilat_async or gossip_world < 2:
+        if args.topology == "auto" or args.mixing_alpha is not None:
+            raise SystemExit("--topology auto / --mixing_alpha plan "
+                             "gossip schedules; they do not apply to "
+                             "all_reduce/bilateral modes or a "
+                             "single-rank world")
+        return
+    from ..planner import resolve_topology
+    from ..train.lr import ppi_at_epoch
+
+    # plan for the epoch-0 peers_per_itr (a ppi schedule can change it
+    # later; the stamped plan records which value was planned for)
+    plan = resolve_topology(
+        gossip_world,
+        ppi=ppi_at_epoch(cfg.ppi_schedule, 0),
+        topology=args.topology,
+        graph_class=cfg.graph_class,
+        floor=args.gap_floor,
+        algorithm="sgp" if cfg.push_sum else "dpsgd",
+        self_weighted=(True if args.mixing_alpha == "auto"
+                       else (args.mixing_alpha or False)),
+        global_avg_every=args.global_avg_every,  # None = policy decides
+        log=log)
+    cfg.graph_class = plan.graph_class
+    if plan.alpha is not None:
+        from ..topology import SelfWeightedMixing
+
+        cfg.mixing_class = lambda a=plan.alpha: SelfWeightedMixing(a)
+    cfg.global_avg_every = plan.global_avg_every
+    cfg.plan = plan.to_dict()
 
 
 def main(argv=None, config_transform=None, extra_args=None):
@@ -272,6 +369,15 @@ def main(argv=None, config_transform=None, extra_args=None):
 
     log = make_logger("main", cfg.verbose)
     world = args.world_size or jax.device_count()
+
+    # launch-time topology policy BEFORE any mesh/device work: planning is
+    # pure numpy, and a below-floor warning must reach the user even when
+    # the launch subsequently fails.  Gossip ranks live on the node axis
+    # of a hierarchical mesh, so that's the world the mixing analysis sees
+    gossip_world = (world // args.nprocs_per_node
+                    if args.nprocs_per_node > 1 else world)
+    _resolve_plan(cfg, args, gossip_world, log)
+
     if args.nprocs_per_node > 1:
         cfg.nprocs_per_node = args.nprocs_per_node
         mesh = make_hierarchical_mesh(args.nprocs_per_node, world)

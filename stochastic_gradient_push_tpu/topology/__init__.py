@@ -24,6 +24,27 @@ GRAPH_TOPOLOGIES = {
     -1: None,
 }
 
+# Name registry for the planner and the human-facing `--topology` flag;
+# plans must be expressible (and round-trippable through checkpoint
+# metadata) without reference to the integer ids above.
+TOPOLOGY_NAMES = {
+    "exponential": DynamicDirectedExponentialGraph,
+    "bipartite-exponential": DynamicBipartiteExponentialGraph,
+    "linear": DynamicDirectedLinearGraph,
+    "bipartite-linear": DynamicBipartiteLinearGraph,
+    "ring": RingGraph,
+    "npeer-exponential": NPeerDynamicDirectedExponentialGraph,
+}
+
+
+def topology_name(graph_class) -> str:
+    """Stable name of a registered topology class (inverse of
+    :data:`TOPOLOGY_NAMES`)."""
+    for name, cls in TOPOLOGY_NAMES.items():
+        if cls is graph_class:
+            return name
+    raise KeyError(f"{graph_class!r} is not a registered topology")
+
 MIXING_STRATEGIES = {
     0: UniformMixing,
     -1: None,
@@ -45,4 +66,6 @@ __all__ = [
     "build_pairing_schedule",
     "GRAPH_TOPOLOGIES",
     "MIXING_STRATEGIES",
+    "TOPOLOGY_NAMES",
+    "topology_name",
 ]

@@ -62,6 +62,13 @@ class TrainerConfig:
     synch_freq: int = 0
     # gossip on every k-th step (communication thinning, sync mode)
     gossip_every: int = 1
+    # exact global average (one allreduce) every k-th step, 0 = off —
+    # the periodic-global-averaging recovery the planner emits for
+    # topologies whose spectral gap is below the floor (planner/policy.py)
+    global_avg_every: int = 0
+    # launch-time topology plan (planner.Plan.to_dict()); logged at
+    # startup and stamped into checkpoint metadata for reproducibility
+    plan: dict | None = None
     # wire dtype for gossip payloads: None = leaf dtype, "bf16" halves
     # ICI traffic with bounded quantization error
     gossip_comm_dtype: str | None = None
@@ -225,6 +232,11 @@ class Trainer:
             raise ValueError(
                 "gossip_comm_dtype currently applies to the push-sum "
                 "family only")
+        if cfg.global_avg_every and (cfg.all_reduce or cfg.bilat
+                                     or cfg.bilat_async):
+            raise ValueError(
+                "global_avg_every applies to the push-sum/D-PSGD gossip "
+                "family (all_reduce is already exact every step)")
         if cfg.all_reduce:
             return all_reduce(axis)
         if cfg.bilat_async:
@@ -246,11 +258,13 @@ class Trainer:
             return sgp(schedule, axis, overlap=cfg.overlap,
                        gossip_every=cfg.gossip_every,
                        comm_dtype=self._comm_dtype(),
-                       staleness=staleness)
+                       staleness=staleness,
+                       global_avg_every=cfg.global_avg_every)
         if cfg.gossip_every != 1:
             raise ValueError("gossip_every is a push-sum knob")
         return dpsgd(schedule, axis, overlap=cfg.overlap,
-                     staleness=staleness)
+                     staleness=staleness,
+                     global_avg_every=cfg.global_avg_every)
 
     def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):
         """Compiled step for a peers-per-itr value; each distinct
@@ -486,6 +500,11 @@ class Trainer:
                         "nn_meter": nn_meter.state_dict(),
                         "data_meter": data_meter.state_dict(),
                     }
+                    if cfg.plan:
+                        # reproducibility: the launch-time topology plan
+                        # (gap, mixing, averaging period, rationale)
+                        # rides with the state it shaped
+                        meta["plan"] = cfg.plan
                     epoch_id = (None if cfg.overwrite_checkpoints else epoch)
                     # global-state backends (orbax on a pod) take the live
                     # sharded arrays — every process writes its own shards

@@ -188,3 +188,117 @@ def test_sgp_with_comm_compression_trains(mesh):
         params, gstate = jax.block_until_ready(f(params, gstate, targets))
     z = np.asarray(params) / np.asarray(gstate.ps_weight).reshape(WORLD, 1)
     np.testing.assert_allclose(z.mean(0), targets.mean(0), atol=2e-2)
+
+
+# -- periodic global averaging (global_avg_every, planner recovery) ----------
+
+def _stacked_init(alg, dim=4):
+    return jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a),
+                                  (WORLD,) + np.shape(a)).copy(),
+        alg.init(jnp.zeros((dim,), jnp.float32)))
+
+
+def _sgd_gossip_step(alg, mesh, lr):
+    def step(params, gstate, target):
+        params, gstate = alg.pre_step(params, gstate)
+        z = alg.eval_params(params, gstate)
+        g = jax.grad(lambda p: 0.5 * jnp.sum((p - target) ** 2))(z)
+        return alg.post_step(params - lr * g, gstate)
+
+    return jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(GOSSIP_AXIS),) * 3,
+        out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+
+
+def test_global_avg_every_matches_manual_sim(mesh):
+    """Ring gossip + SGD with an exact global average every 3rd step
+    matches the numpy reference trajectory exactly: gossip rounds mix,
+    and on fire steps every rank snaps to the cross-rank mean."""
+    from stochastic_gradient_push_tpu.topology import RingGraph
+
+    sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
+    k = 3
+    alg = sgp(sched, GOSSIP_AXIS, global_avg_every=k)
+    rng = np.random.default_rng(6)
+    x0 = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    targets = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    lr = 0.1
+    f = _sgd_gossip_step(alg, mesh, lr)
+
+    params, gstate = x0.copy(), _stacked_init(alg)
+    sim = x0.astype(np.float64).copy()
+    for t in range(1, 9):
+        params, gstate = jax.block_until_ready(f(params, gstate, targets))
+        sim = sched.mixing_matrix(t - 1) @ (sim - lr * (sim - targets))
+        if t % k == 0:
+            sim = np.broadcast_to(sim.mean(0), sim.shape).copy()
+        np.testing.assert_allclose(np.asarray(params), sim,
+                                   rtol=1e-5, atol=1e-5, err_msg=str(t))
+        # ps-weight is 1 after an average (regular mixing keeps it 1)
+        np.testing.assert_allclose(
+            np.asarray(gstate.ps_weight).reshape(WORLD), np.ones(WORLD),
+            atol=1e-6)
+
+
+def test_global_avg_exact_consensus_under_irregular_mixing(mesh):
+    """With per-rank irregular mixing the push-sum weight deviates from 1;
+    the every-k average must still land every rank exactly on the true
+    mean (Σ numerators / Σ weights) and reset the weight to 1."""
+    from stochastic_gradient_push_tpu.topology import SelfWeightedMixing
+
+    alphas = 0.2 + 0.6 * np.arange(WORLD) / (WORLD - 1)
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1),
+        SelfWeightedMixing(alpha=alphas))
+    k = 4
+    alg = sgp(sched, GOSSIP_AXIS, global_avg_every=k)
+    rng = np.random.default_rng(7)
+    x0 = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    f = _sgd_gossip_step(alg, mesh, lr=0.0)  # pure averaging dynamics
+
+    params, gstate = x0.copy(), _stacked_init(alg)
+    for _ in range(k):
+        params, gstate = jax.block_until_ready(
+            f(params, gstate, jnp.zeros_like(params)))
+    # mass conservation makes the consensus value the exact initial mean
+    np.testing.assert_allclose(
+        np.asarray(params),
+        np.broadcast_to(x0.mean(0), x0.shape), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(gstate.ps_weight).reshape(WORLD), np.ones(WORLD),
+        atol=1e-6)
+
+
+def test_global_avg_composes_with_gossip_thinning(mesh):
+    """gossip_every=2 + global_avg_every=3: thinned rounds fire on their
+    own cadence, the exact average on its own; the numpy sim agrees."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, gossip_every=2, global_avg_every=3)
+    rng = np.random.default_rng(8)
+    x0 = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    targets = rng.normal(size=(WORLD, 4)).astype(np.float32)
+    lr = 0.1
+    f = _sgd_gossip_step(alg, mesh, lr)
+
+    params, gstate = x0.copy(), _stacked_init(alg)
+    sim = x0.astype(np.float64).copy()
+    for t in range(12):
+        params, gstate = jax.block_until_ready(f(params, gstate, targets))
+        sim = sim - lr * (sim - targets)
+        if t % 2 == 0:          # thinned gossip fires, rotation t//2
+            sim = sched.mixing_matrix(t // 2) @ sim
+        if (t + 1) % 3 == 0:    # exact average fires after the round
+            sim = np.broadcast_to(sim.mean(0), sim.shape).copy()
+        np.testing.assert_allclose(np.asarray(params), sim,
+                                   rtol=1e-5, atol=1e-5, err_msg=str(t))
+
+
+def test_global_avg_validation():
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    with pytest.raises(ValueError, match="global_avg_every"):
+        sgp(sched, GOSSIP_AXIS, global_avg_every=-1)
+    with pytest.raises(ValueError, match="synchronous"):
+        sgp(sched, GOSSIP_AXIS, overlap=True, global_avg_every=2)

@@ -441,3 +441,105 @@ def test_load_corpus_variants(tmp_path):
     assert b.shape == (150,) and int(b.max()) < 256
     with pytest.raises(ValueError, match="vocab_size >= 256"):
         load_corpus(str(txt), 100)
+
+
+# -- planner wiring (--topology / --gap_floor / --global_avg_every) ----------
+
+def test_topology_flag_forces_named_graph():
+    from stochastic_gradient_push_tpu.run.gossip_sgd import parse_config
+    from stochastic_gradient_push_tpu.topology import (
+        DynamicBipartiteLinearGraph, RingGraph)
+
+    cfg, args = parse_config(["--topology", "ring"])
+    assert cfg.graph_class is RingGraph
+    # the name overrides the integer registry
+    cfg, _ = parse_config(["--topology", "bipartite-linear",
+                           "--graph_type", "4"])
+    assert cfg.graph_class is DynamicBipartiteLinearGraph
+
+
+def test_topology_flag_validation():
+    from stochastic_gradient_push_tpu.run.gossip_sgd import parse_config
+
+    with pytest.raises(SystemExit):
+        parse_config(["--topology", "auto", "--all_reduce", "True",
+                      "--graph_type", "-1"])
+    with pytest.raises(SystemExit):
+        parse_config(["--mixing_alpha", "bogus"])
+    with pytest.raises(SystemExit):
+        parse_config(["--mixing_alpha", "1.5"])
+    with pytest.raises(SystemExit):  # D-PSGD needs a regular schedule
+        parse_config(["--mixing_alpha", "auto", "--push_sum", "False"])
+    with pytest.raises(SystemExit):  # AllReduce doesn't mix at all
+        parse_config(["--mixing_alpha", "auto", "--all_reduce", "True",
+                      "--graph_type", "-1"])
+
+
+def test_global_avg_every_threads_into_config():
+    from stochastic_gradient_push_tpu.run.gossip_sgd import parse_config
+
+    cfg, _ = parse_config(["--global_avg_every", "5"])
+    assert cfg.global_avg_every == 5
+
+
+def test_resolve_plan_auto_configures_trainer_config():
+    """_resolve_plan mutates the TrainerConfig exactly as main() would:
+    planned graph class, stamped plan dict, averaging period."""
+    from stochastic_gradient_push_tpu.run.gossip_sgd import (
+        _resolve_plan, parse_config)
+    from stochastic_gradient_push_tpu.topology import RingGraph
+    from stochastic_gradient_push_tpu.utils import make_logger
+
+    log = make_logger("test-plan", verbose=False)
+    cfg, args = parse_config(["--topology", "auto"])
+    _resolve_plan(cfg, args, 64, log)
+    assert cfg.graph_class is not RingGraph
+    assert cfg.plan and cfg.plan["auto"] and cfg.plan["gap"] >= 0.01
+    assert cfg.global_avg_every == 0
+
+    # forced ring at 64: warned (log) + periodic averaging enabled
+    cfg, args = parse_config(["--topology", "ring"])
+    _resolve_plan(cfg, args, 64, log)
+    assert cfg.graph_class is RingGraph
+    assert cfg.plan["warnings"] and cfg.global_avg_every == 100
+
+    # alpha co-optimization rides the plan into mixing_class
+    cfg, args = parse_config(["--topology", "auto",
+                              "--mixing_alpha", "auto",
+                              "--peers_per_itr_schedule", "0", "4"])
+    _resolve_plan(cfg, args, 64, log)
+    mixing = cfg.mixing_class()
+    assert float(mixing.alpha[0]) == pytest.approx(cfg.plan["alpha"])
+
+
+def test_lm_rejects_topology_outside_gossip_family():
+    """A forced --topology must never be silently dropped: all_reduce and
+    bilat modes reject it instead of falling back to --graph_type."""
+    from stochastic_gradient_push_tpu.run.gossip_lm import main as lm_main
+
+    base = ["--world_size", "8", "--seq_len", "32", "--d_model", "32",
+            "--n_layers", "1", "--n_heads", "4", "--d_ff", "32",
+            "--vocab_size", "32", "--batch_size", "2", "--num_steps", "1"]
+    with pytest.raises(SystemExit, match="does not apply"):
+        lm_main(base + ["--topology", "ring", "--all_reduce", "True"])
+    with pytest.raises(SystemExit, match="does not apply"):
+        lm_main(base + ["--topology", "auto", "--bilat", "True"])
+
+
+@pytest.mark.slow
+def test_cli_topology_auto_end_to_end(tmp_path):
+    """--topology auto through the full CLI: plan logged, training runs,
+    plan stamped into checkpoint metadata."""
+    r = _run_cli("stochastic_gradient_push_tpu.run.gossip_sgd", tmp_path,
+                 extra=("--topology", "auto"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "gossip plan: " in r.stdout + r.stderr
+    import flax.serialization
+
+    raw = flax.serialization.msgpack_restore(
+        (tmp_path / "checkpoint_r0_n8.ckpt").read_bytes())
+    plan = raw["meta"]["plan"]
+    assert plan["auto"] and plan["topology"] in (
+        "bipartite-exponential", "bipartite-linear", "linear",
+        "npeer-exponential", "exponential")
+    assert plan["gap"] >= 0.01
