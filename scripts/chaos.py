@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""chaos — gossip fault injection: describe plans, run the CI selftest.
+
+Usage:
+    python scripts/chaos.py --selftest                 # CI self-check
+    python scripts/chaos.py --describe 'drop:0->1@0:64' --topology ring
+    python scripts/chaos.py --describe 'straggler:3@10:20;seed:7' \\
+        --topology npeer-exponential --world 16
+
+Exit codes: 0 clean, 1 selftest failure, 2 unsupported configuration.
+
+The selftest pins the resilience acceptance loop on a world-8 virtual
+CPU mesh: a dropped gossip edge preserves the network-wide parameter
+mean to float32 tolerance (mass-conserving drop semantics), the runtime
+monitor reports the residual excursion in a structured ``gossip
+health:`` line, and recovery restores consensus below the floor within
+one global-average cycle.
+"""
+
+import os
+import signal
+import sys
+
+# die quietly when piped into `head` instead of tracebacking
+signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+
+# the selftest needs a world-8 mesh: force the virtual CPU platform
+# BEFORE jax loads (same pattern as scripts/plan.py, plus device count)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stochastic_gradient_push_tpu.resilience.chaos import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

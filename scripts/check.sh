@@ -21,6 +21,10 @@ echo "== planner self-check =="
 python scripts/plan.py --world 8 --selftest
 
 echo
+echo "== chaos self-check (resilience: faults -> monitor -> recovery) =="
+python scripts/chaos.py --selftest
+
+echo
 echo "== tier-1 tests (CPU, not slow) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -81,10 +81,29 @@ class PushSumGossip(GossipAlgorithm):
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, track_weight: bool = True,
                  gossip_every: int = 1, comm_dtype=None,
-                 staleness: int = 1, global_avg_every: int = 0):
+                 staleness: int = 1, global_avg_every: int = 0,
+                 faults=None):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
+        # deterministic fault injection (resilience/faults.py FaultMasks):
+        # the mixing boundary applies the plan's keep/corrupt masks with
+        # mass-conserving reabsorption.  Synchronous mode only — an
+        # overlap share launched under one fault state and consumed under
+        # another would decouple the mask from the wire it describes.
+        if faults is not None and overlap:
+            raise ValueError(
+                "inject_faults is a synchronous-mode feature: overlap "
+                "in-flight shares would straddle fault windows")
+        if faults is not None and faults.gossip_every != gossip_every:
+            # phase-dependent masks are resolved against the rotation
+            # actually active at each tick, which depends on thinning
+            raise ValueError(
+                f"fault masks were compiled for gossip_every="
+                f"{faults.gossip_every} but the algorithm runs "
+                f"gossip_every={gossip_every}; rebuild the masks with "
+                "the matching thinning factor")
+        self.faults = faults
         if staleness < 1:
             raise ValueError("staleness must be >= 1")
         if staleness > 1 and not overlap:
@@ -119,11 +138,11 @@ class PushSumGossip(GossipAlgorithm):
     def _zeros_like_params(self, params: Params):
         return jax.tree.map(jnp.zeros_like, params)
 
-    def _mix(self, params, ps_weight, phase):
+    def _mix(self, params, ps_weight, phase, tick=None):
         if self.track_weight:
             return collectives.mix_push_sum(
                 params, ps_weight, phase, self.schedule, self.axis_name,
-                comm_dtype=self.comm_dtype)
+                comm_dtype=self.comm_dtype, faults=self.faults, tick=tick)
         return (collectives.mix_push_pull(
             params, phase, self.schedule, self.axis_name,
             comm_dtype=self.comm_dtype), ps_weight)
@@ -238,7 +257,9 @@ class PushSumGossip(GossipAlgorithm):
 
         def mix_branch(operand):
             p, w = operand
-            p, w = self._mix(p, w, rotation)
+            # faults are indexed by the step clock (tick), not the slower
+            # rotation counter — a fault window means wall steps
+            p, w = self._mix(p, w, rotation, tick=tick)
             return p, jnp.reshape(jnp.asarray(w, jnp.float32),
                                   jnp.shape(state.ps_weight))
 
@@ -249,22 +270,31 @@ class PushSumGossip(GossipAlgorithm):
         return params, state.replace(phase=state.phase + 1,
                                      ps_weight=ps_weight)
 
+    def global_average(self, params, ps_weight):
+        """Exact push-sum consensus NOW: ``x ← Σ params / Σ ps_weight``
+        (one allreduce) and the weight resets to 1.  Mass conservation
+        makes that ratio the true parameter average under any
+        column-stochastic mixing — including faulted mixing with
+        mass-conserving drops — so the trajectory mean is untouched while
+        consensus error snaps to zero.  Called per-rank inside
+        shard_map; the periodic schedule (:meth:`_maybe_global_average`)
+        and the resilience recovery path (resilience/recovery.py) both
+        route through here."""
+        tot_p, tot_w = collectives.allreduce_sum((params, ps_weight),
+                                                 self.axis_name)
+        tw = as_scalar(tot_w)
+        params = jax.tree.map(lambda a: (a / tw.astype(a.dtype)), tot_p)
+        return params, jnp.ones_like(ps_weight)
+
     def _maybe_global_average(self, params, ps_weight, tick_next):
-        """Every ``global_avg_every`` steps: snap to the exact push-sum
-        consensus ``Σ params / Σ ps_weight`` and reset the weight to 1.
-        Mass conservation makes that ratio the true parameter average
-        under any column-stochastic mixing, so the trajectory mean is
-        untouched while consensus error drops to zero."""
+        """Every ``global_avg_every`` steps: fire :meth:`global_average`
+        (periodic global averaging, Chen et al.)."""
         if self.global_avg_every <= 0:
             return params, ps_weight
         fire = (as_scalar(tick_next) % self.global_avg_every) == 0
 
         def avg_branch(operand):
-            p, w = operand
-            tot_p, tot_w = collectives.allreduce_sum((p, w), self.axis_name)
-            tw = as_scalar(tot_w)
-            p = jax.tree.map(lambda a: (a / tw.astype(a.dtype)), tot_p)
-            return p, jnp.ones_like(w)
+            return self.global_average(*operand)
 
         return jax.lax.cond(fire, avg_branch, lambda o: o,
                             (params, ps_weight))
@@ -296,10 +326,19 @@ class PushPullGossip(PushSumGossip):
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, staleness: int = 1,
-                 global_avg_every: int = 0):
+                 global_avg_every: int = 0, faults=None):
         if not schedule.regular:
             raise ValueError("D-PSGD requires a regular schedule "
                              "(doubly-stochastic mixing)")
+        if faults is not None:
+            # a dropped edge breaks ROW-stochasticity even with sender
+            # reabsorption, and without a ps-weight there is no mass
+            # accounting to absorb the asymmetry — the exact failure mode
+            # push-sum exists to survive (Assran et al. 2018, §1)
+            raise ValueError(
+                "inject_faults requires push-sum: D-PSGD's "
+                "doubly-stochastic invariant does not survive dropped "
+                "edges (use --push_sum True)")
         super().__init__(schedule, axis_name, overlap=overlap,
                          track_weight=overlap, staleness=staleness,
                          global_avg_every=global_avg_every)
@@ -339,11 +378,11 @@ def all_reduce(axis_name: str) -> AllReduce:
 def sgp(schedule: GossipSchedule, axis_name: str,
         overlap: bool = False, gossip_every: int = 1,
         comm_dtype=None, staleness: int = 1,
-        global_avg_every: int = 0) -> PushSumGossip:
+        global_avg_every: int = 0, faults=None) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
                          gossip_every=gossip_every, comm_dtype=comm_dtype,
                          staleness=staleness,
-                         global_avg_every=global_avg_every)
+                         global_avg_every=global_avg_every, faults=faults)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str,
@@ -354,10 +393,10 @@ def osgp(schedule: GossipSchedule, axis_name: str,
 
 def dpsgd(schedule: GossipSchedule, axis_name: str,
           overlap: bool = False, staleness: int = 1,
-          global_avg_every: int = 0) -> PushPullGossip:
+          global_avg_every: int = 0, faults=None) -> PushPullGossip:
     return PushPullGossip(schedule, axis_name, overlap=overlap,
                           staleness=staleness,
-                          global_avg_every=global_avg_every)
+                          global_avg_every=global_avg_every, faults=faults)
 
 
 def adpsgd(pairing: np.ndarray, axis_name: str) -> BilateralGossip:

@@ -68,7 +68,7 @@ def _rank_weight(table: np.ndarray, axis_name: str):
 
 
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
-              comm_dtype=None):
+              comm_dtype=None, faults=None):
     """Build the mixing function for one static phase of the schedule.
 
     ``comm_dtype`` (e.g. ``jnp.bfloat16``) compresses the wire payload:
@@ -77,20 +77,44 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
     quantization error per round.  The local share always stays full
     precision, so the push-sum mass error is bounded by the received
     fraction of each round.
+
+    ``faults`` (a :class:`~..resilience.faults.FaultMasks`) injects
+    deterministic edge failures: the built function then takes
+    ``(tree, tick)`` instead of ``tree``, masks each outgoing message with
+    the plan's keep table at ``tick``, and — mass-conserving semantics —
+    reabsorbs the undelivered mixing weight into the sender's local share
+    so the effective matrix stays column-stochastic (push-sum remains
+    exactly mean-preserving under any fault plan).  NaN corruption
+    poisons real payload leaves only; the push-sum weight lane stays
+    finite so ps-weight telemetry survives the fault.
     """
     lo_table = schedule.self_weight[phase_idx]
     edge_w = schedule.edge_weights[phase_idx]
     perms = schedule.perms[phase_idx]
 
-    def fn(tree):
+    def mix(tree, tick):
         lo = _rank_weight(lo_table, axis_name)
         out = jax.tree.map(lambda a: a * lo.astype(a.dtype), tree)
+        corrupt = (faults.corrupt_at(tick, axis_name)
+                   if faults is not None and faults.any_corruption else None)
         for i in range(schedule.peers_per_itr):
             w_i = _rank_weight(edge_w[i], axis_name)
+            keep = (faults.keep_at(tick, i, axis_name)
+                    if faults is not None else None)
             pairs = _perm_pairs(perms[i])
 
             def send(a):
                 msg = a * w_i.astype(a.dtype)
+                # corrupt real payloads only (size > 1, like compression):
+                # a poisoned de-bias divisor would blind the very
+                # ps-weight telemetry that detects the fault
+                if corrupt is not None and msg.size > 1:
+                    msg = jnp.where(corrupt > 0,
+                                    jnp.asarray(jnp.nan, msg.dtype), msg)
+                if keep is not None:
+                    # a dropped edge delivers nothing — `where`, not `*`,
+                    # so a dropped+corrupted message is 0, never 0·NaN
+                    msg = jnp.where(keep > 0, msg, jnp.zeros_like(msg))
                 # compress real payloads only: scalar leaves (the push-sum
                 # weight) stay full precision — quantizing the de-bias
                 # divisor buys no bandwidth and drifts every parameter
@@ -103,13 +127,26 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
 
             recv = jax.tree.map(send, tree)
             out = jax.tree.map(jnp.add, out, recv)
+            if keep is not None and faults.reabsorb:
+                # sender reabsorbs the undelivered weight: the effective
+                # column still sums to 1 (mass conservation)
+                drop_w = w_i * (1.0 - keep)
+                out = jax.tree.map(
+                    lambda o, a: o + a * drop_w.astype(a.dtype), out, tree)
         return out
+
+    if faults is None:
+        return lambda tree: mix(tree, None)
+
+    def fn(operand):
+        tree, tick = operand
+        return mix(tree, tick)
 
     return fn
 
 
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
-                 comm_dtype=None):
+                 comm_dtype=None, faults=None, tick=None):
     """One synchronous gossip round over an arbitrary pytree.
 
     Computes ``lo * x + Σ_i ppermute(w_i * x, perm_i(phase))`` — the
@@ -118,6 +155,12 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
     rotation (graph_manager.py:128-133) is a free modulo, not communicator
     churn.  ``comm_dtype`` compresses the wire payload (see
     :func:`_round_fn`).
+
+    ``faults`` applies a compiled fault plan (resilience/faults.py) with
+    mass-conserving drop semantics; ``tick`` is the fault-time index (a
+    traced step counter, defaults to ``phase`` — they coincide except
+    under communication thinning, where the rotation advances slower than
+    the step clock).
     """
     axis_size = lax.axis_size(axis_name)
     if axis_size != schedule.world_size:
@@ -126,6 +169,16 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
             f"mesh axis '{axis_name}' has size {axis_size}")
     if schedule.world_size == 1:
         return tree
+    if faults is not None:
+        tick = as_scalar(phase if tick is None else tick)
+        operand = (tree, tick)
+        if schedule.num_phases == 1:
+            return _round_fn(schedule, 0, axis_name, comm_dtype,
+                             faults)(operand)
+        branches = [_round_fn(schedule, p, axis_name, comm_dtype, faults)
+                    for p in range(schedule.num_phases)]
+        return lax.switch(as_scalar(phase) % schedule.num_phases, branches,
+                          operand)
     if schedule.num_phases == 1:
         return _round_fn(schedule, 0, axis_name, comm_dtype)(tree)
     branches = [_round_fn(schedule, p, axis_name, comm_dtype)
@@ -134,7 +187,7 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
 
 
 def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
-                 axis_name: str, comm_dtype=None):
+                 axis_name: str, comm_dtype=None, faults=None, tick=None):
     """Push-sum round: jointly mixes parameters and the push-sum weight.
 
     The reference appends the scalar ps-weight to the flat payload only when
@@ -144,10 +197,12 @@ def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
     Returns ``(mixed_params, mixed_ps_weight)``.  For regular schedules a
     complete synchronous round maps ``ps_weight == 1 → 1``, which is the
     algebraic form of the reference's lazy-mixing shortcut
-    (distributed.py:188-191).
+    (distributed.py:188-191).  Under ``faults`` the ps-weight rides the
+    same masked round, so mass conservation — and therefore the de-biased
+    consensus value — survives every mass-conserving fault plan.
     """
     mixed = gossip_round((params, ps_weight), phase, schedule, axis_name,
-                         comm_dtype=comm_dtype)
+                         comm_dtype=comm_dtype, faults=faults, tick=tick)
     return mixed
 
 

@@ -28,13 +28,15 @@ from .scorer import DEFAULT_PEER_COUNTS, score_candidates
 
 def _print_table(cands, floor: float) -> None:
     print(f"{'topology':<24} {'ppi':>3} {'gap':>8} {'phases':>6} "
-          f"{'msgs/efold':>10}  floor")
+          f"{'msgs/efold':>10} {'hops/efold':>10}  floor")
     for c in cands:
         cost = f"{c.comm_cost:10.1f}" if c.comm_cost != float("inf") \
             else f"{'inf':>10}"
+        hops = f"{c.hop_cost:10.1f}" if c.hop_cost != float("inf") \
+            else f"{'inf':>10}"
         mark = "ok" if c.meets(floor) else "BELOW"
         print(f"{c.topology:<24} {c.ppi:>3} {c.gap:>8.4f} "
-              f"{c.num_phases:>6} {cost}  {mark}")
+              f"{c.num_phases:>6} {cost} {hops}  {mark}")
 
 
 def _selftest(world: int, floor: float) -> int:

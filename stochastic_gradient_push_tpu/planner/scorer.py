@@ -17,11 +17,20 @@ This module turns that observation into a ranking:
   each round sending ``peers_per_itr`` messages per rank.  Exact-consensus
   cycles (gap 1.0, e.g. DynamicBipartiteLinearGraph at even worlds) cost
   exactly one cycle.
+* **hop cost** — the same model with each message weighted by its ring
+  hop distance on the device mesh instead of counting all messages
+  equally: gossip ranks are laid out along a 1-D mesh axis whose
+  neighbors ride the shortest ICI path, so a message to rank ``±d`` costs
+  ``min(d, n−d)`` link traversals (the wrap-around torus link closes the
+  ring).  Two isomorphic graphs with identical spectral gaps can differ
+  several-fold here — a stride-3 "ring" mixes exactly like the neighbor
+  ring but pays 3 hops per message.
 
 Ranking prefers candidates that clear the gap floor, then the cheapest
-consensus, then the largest gap — so a slow-but-connected ring never
-outranks an exponential graph, and among perfect mixers the one with the
-shortest cycle wins.
+*hop-weighted* consensus, then the largest gap — so a slow-but-connected
+ring never outranks an exponential graph, among perfect mixers the one
+with the shortest cycle wins, and among equal mixers the one hugging the
+physical interconnect wins.
 
 Everything here is plain numpy over small ``world × world`` matrices; the
 full candidate grid for a 64-rank pod scores in well under a second on one
@@ -45,6 +54,8 @@ __all__ = [
     "DEFAULT_PEER_COUNTS",
     "consensus_cost",
     "evaluate_candidate",
+    "hops_per_round",
+    "ring_hop_distance",
     "score_candidates",
 ]
 
@@ -69,6 +80,7 @@ class Candidate:
     num_phases: int          # rotation phases per cycle
     rounds_per_efold: float  # gossip rounds per e-fold of consensus error
     comm_cost: float         # messages per rank per e-fold (rounds × ppi)
+    hop_cost: float = math.inf  # ring-hop-weighted messages per e-fold
 
     @property
     def graph_class(self):
@@ -82,6 +94,8 @@ class Candidate:
         d = dataclasses.asdict(self)
         d["comm_cost"] = round(self.comm_cost, 3) \
             if math.isfinite(self.comm_cost) else None
+        d["hop_cost"] = round(self.hop_cost, 3) \
+            if math.isfinite(self.hop_cost) else None
         d["rounds_per_efold"] = round(self.rounds_per_efold, 3) \
             if math.isfinite(self.rounds_per_efold) else None
         return d
@@ -101,6 +115,34 @@ def consensus_cost(gap: float, num_phases: int, ppi: int
     return rounds, rounds * ppi
 
 
+def ring_hop_distance(src: int, dst: int, world: int) -> int:
+    """ICI link traversals between two gossip ranks laid out on a 1-D
+    mesh axis with a wrap-around link (ring/torus): the shorter way
+    around, ``min(|d|, n − |d|)``."""
+    d = (dst - src) % world
+    return min(d, world - d)
+
+
+def hops_per_round(schedule) -> float:
+    """Average ring-hop-weighted messages per rank per gossip round.
+
+    The per-phase mean over ranks of ``Σ_i hop(src → perms[p, i, src])``
+    — equals ``peers_per_itr`` when every edge is nearest-neighbor, and
+    grows with the graph's reach (an exponential graph's 2^k-distance
+    edges are its mixing power AND its wire cost).
+    """
+    n = schedule.world_size
+    if n <= 1:
+        return 0.0
+    total = 0.0
+    for p in range(schedule.num_phases):
+        for i in range(schedule.peers_per_itr):
+            total += sum(ring_hop_distance(src, int(schedule.perms[p, i,
+                                                                   src]), n)
+                         for src in range(n))
+    return total / (schedule.num_phases * n)
+
+
 def evaluate_candidate(graph_class, world: int, ppi: int,
                        mixing: MixingStrategy | None = None
                        ) -> Candidate | None:
@@ -116,6 +158,8 @@ def evaluate_candidate(graph_class, world: int, ppi: int,
         raise
     gap = spectral_gap(schedule)
     rounds, cost = consensus_cost(gap, schedule.num_phases, ppi)
+    hop_cost = rounds * hops_per_round(schedule) \
+        if math.isfinite(rounds) else math.inf
     alpha = None
     mix_name = "uniform"
     if isinstance(mixing, SelfWeightedMixing):
@@ -124,10 +168,17 @@ def evaluate_candidate(graph_class, world: int, ppi: int,
                              "alpha tables are a run-layer concern")
         alpha = float(mixing.alpha[0])
         mix_name = f"self-weighted({alpha:.4f})"
-    return Candidate(topology=topology_name(graph_class), world=world,
+    try:
+        name = topology_name(graph_class)
+    except KeyError:
+        # unregistered classes (tests, user extensions) still score; only
+        # Plan round-tripping needs a registry name
+        name = graph_class.__name__
+    return Candidate(topology=name, world=world,
                      ppi=ppi, mixing=mix_name, alpha=alpha, gap=gap,
                      num_phases=schedule.num_phases,
-                     rounds_per_efold=rounds, comm_cost=cost)
+                     rounds_per_efold=rounds, comm_cost=cost,
+                     hop_cost=hop_cost)
 
 
 def score_candidates(world: int,
@@ -145,7 +196,8 @@ def score_candidates(world: int,
       allowed: optional iterable of topology names restricting the search.
 
     Returns candidates sorted best-first: clears-the-floor, then cheapest
-    consensus, then largest gap, then (name, ppi) for determinism.
+    hop-weighted consensus (mesh-distance comm model), then largest gap,
+    then (name, ppi) for determinism.
     """
     names = sorted(TOPOLOGY_NAMES) if allowed is None else sorted(allowed)
     unknown = [n for n in names if n not in TOPOLOGY_NAMES]
@@ -159,6 +211,6 @@ def score_candidates(world: int,
                                    UniformMixing())
             if c is not None:
                 cands.append(c)
-    cands.sort(key=lambda c: (not c.meets(floor), c.comm_cost, -c.gap,
+    cands.sort(key=lambda c: (not c.meets(floor), c.hop_cost, -c.gap,
                               c.topology, c.ppi))
     return cands

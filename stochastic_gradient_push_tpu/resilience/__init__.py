@@ -1,0 +1,29 @@
+"""Resilience: fault injection, runtime health monitoring, self-healing.
+
+The runtime half of the robustness story the planner (planner/) begins at
+launch: :mod:`.faults` *proves* fault tolerance with deterministic,
+mass-conserving fault injection at the gossip mixing boundary;
+:mod:`.monitor` *sees* divergence through cheap in-step health signals
+emitted as structured ``gossip health:`` JSONL; :mod:`.recovery` *acts*,
+firing an immediate exact global average (the Chen et al. primitive) and
+re-consulting the planner.  ``scripts/chaos.py --selftest`` is the CI
+entry point that exercises the whole loop on a virtual CPU mesh.
+"""
+
+from .faults import FaultEvent, FaultMasks, FaultPlan, parse_fault_spec
+from .monitor import HEALTH_KEYS, HealthMonitor, HealthReport, health_signals
+from .recovery import RecoveryEvent, RecoveryPolicy, make_recovery_fn
+
+__all__ = [
+    "FaultEvent",
+    "FaultMasks",
+    "FaultPlan",
+    "parse_fault_spec",
+    "HEALTH_KEYS",
+    "HealthMonitor",
+    "HealthReport",
+    "health_signals",
+    "RecoveryEvent",
+    "RecoveryPolicy",
+    "make_recovery_fn",
+]

@@ -1,0 +1,250 @@
+"""``scripts/chaos.py`` driver — chaos testing and the CI selftest.
+
+Modes:
+
+* ``--selftest`` — the resilience acceptance loop on a world-8 virtual
+  CPU mesh: inject a single-edge drop, pin that the network-wide
+  parameter mean is preserved to float32 tolerance (mass-conserving drop
+  semantics), that the monitor reports the residual excursion in a
+  structured ``gossip health:`` line, and that recovery drives the
+  consensus residual back below the floor within one global-average
+  cycle;
+* ``--describe SPEC`` — parse a fault spec against a topology and print
+  what it compiles to: events, mask period, per-tick dropped-edge
+  counts, and the worst effective-matrix column-sum error (0 under
+  mass-conserving semantics — the SGPV102 invariant).
+
+Everything runs on CPU in seconds; the wrapper script forces the
+virtual 8-device platform before jax loads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from .faults import parse_fault_spec
+
+WORLD = 8
+SELFTEST_SPEC = "drop:0->1@0:64;seed:7"
+SELFTEST_ROUNDS = 12
+
+
+class _Capture(logging.Handler):
+    """Collect emitted log lines so the selftest can assert on them."""
+
+    def __init__(self):
+        super().__init__()
+        self.lines: list[str] = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+def _selftest(residual_floor: float) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from ..algorithms import sgp
+    from ..analysis import verify_schedule
+    from ..parallel import GOSSIP_AXIS, make_gossip_mesh
+    from ..topology import RingGraph, build_schedule
+    from .monitor import HEALTH_KEYS, HealthMonitor, health_signals
+    from .recovery import RecoveryPolicy, make_recovery_fn
+
+    failures: list[str] = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    if jax.device_count() < WORLD:
+        print(f"chaos selftest FAILED: needs {WORLD} devices, have "
+              f"{jax.device_count()} (run via scripts/chaos.py, which "
+              "forces the virtual CPU platform)", file=sys.stderr)
+        return 1
+
+    # the ring is the topology where a single dead edge hurts most — the
+    # honest worst case for the recovery claim
+    sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
+    plan = parse_fault_spec(SELFTEST_SPEC)
+    masks = plan.build_masks(sched)
+
+    # 1. algebra: the faulted mixing matrices pass the verifier's
+    # column-stochasticity check (SGPV102) — mass conservation by
+    # construction, not by luck
+    for tick in (0, 1, SELFTEST_ROUNDS - 1):
+        eff = plan.effective_schedule(sched, tick)
+        findings, _ = verify_schedule(eff, f"faulted-ring@t{tick}",
+                                      "<chaos>", 0)
+        check(not findings,
+              f"effective schedule at tick {tick} failed verification: "
+              + "; ".join(f.message for f in findings))
+
+    # 2. dynamics: run the faulted gossip on the real compiled path
+    alg = sgp(sched, GOSSIP_AXIS, faults=masks)
+    mesh = make_gossip_mesh(WORLD)
+
+    def gossip_step(params, gstate):
+        params, gstate = alg.post_step(params, gstate)
+        sig = health_signals(params, None, gstate.ps_weight, GOSSIP_AXIS)
+        return params, gstate, jax.tree.map(lambda a: a[None], sig)
+
+    step = jax.jit(jax.shard_map(
+        gossip_step, mesh=mesh, in_specs=(P(GOSSIP_AXIS),) * 2,
+        out_specs=(P(GOSSIP_AXIS),) * 3))
+
+    rng = np.random.default_rng(0)
+    params = rng.normal(size=(WORLD, 128)).astype(np.float32)
+    x0 = params.copy()
+    gstate = jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a),
+                                  (WORLD,) + np.shape(a)).copy(),
+        alg.init(jnp.zeros((128,), jnp.float32)))
+
+    capture = _Capture()
+    log = logging.getLogger("chaos-selftest")
+    log.setLevel(logging.INFO)
+    log.addHandler(capture)
+    monitor = HealthMonitor(health_every=1, residual_floor=residual_floor,
+                            log=log)
+    policy = RecoveryPolicy(world=WORLD, topology="ring",
+                            residual_floor=residual_floor,
+                            cooldown_steps=0, log=log)
+    recovery = make_recovery_fn(alg, mesh)
+
+    last_report = None
+    for t in range(SELFTEST_ROUNDS):
+        params, gstate, sig = jax.block_until_ready(step(params, gstate))
+        sig = {k: float(np.asarray(v)[0]) for k, v in sig.items()}
+        last_report = monitor.observe(t, sig)
+
+    # mean preservation under the dropped edge, float32 tolerance
+    drift = np.abs(np.asarray(params).mean(0) - x0.mean(0)).max()
+    check(drift < 1e-5,
+          f"network mean drifted {drift:.2e} under the dropped edge "
+          "(mass-conserving semantics violated)")
+    check(float(sig["ps_mass_err"]) < 1e-4,
+          f"push-sum mass error {sig['ps_mass_err']:.2e} under "
+          "mass-conserving drops")
+
+    # the monitor must have reported the excursion in a structured line
+    check(last_report is not None and last_report.unhealthy
+          and "residual-above-floor" in last_report.reasons,
+          "monitor did not flag the residual excursion")
+    health_lines = [l for l in capture.lines
+                    if l.startswith("gossip health: ")]
+    check(any("residual-above-floor" in l for l in health_lines),
+          "no structured 'gossip health:' line reported the excursion")
+    for line in health_lines[:1]:
+        payload = json.loads(line[len("gossip health: "):])
+        check("consensus_residual" in payload and "step" in payload,
+              "health line payload is not the structured schema")
+
+    # 3. recovery: one global-average cycle must close the excursion
+    event = policy.assess(last_report)
+    check(event.action == "global-average",
+          f"policy chose {event.action!r} instead of global-average")
+    check(event.suggestion is not None
+          and event.suggestion.get("topology"),
+          "recovery did not consult the planner for a suggestion")
+    new_params, new_w = recovery(params, gstate.ps_weight)
+    gstate = gstate.replace(ps_weight=new_w)
+    params = new_params
+    post_drift = np.abs(np.asarray(params).mean(0) - x0.mean(0)).max()
+    check(post_drift < 1e-5,
+          f"global average moved the network mean by {post_drift:.2e}")
+    check(np.allclose(np.asarray(gstate.ps_weight), 1.0),
+          "recovery did not reset push-sum weights to 1")
+    # one more faulted gossip round, then measure the residual the
+    # monitor would see: below the floor within one cycle
+    params, gstate, sig = jax.block_until_ready(step(params, gstate))
+    residual = float(np.asarray(sig["consensus_residual"])[0])
+    check(residual < residual_floor,
+          f"consensus residual {residual:.2e} still above the floor "
+          f"{residual_floor} one cycle after recovery")
+    check(any(l.startswith("gossip recovery: ") for l in capture.lines),
+          "no structured 'gossip recovery:' line was emitted")
+
+    if failures:
+        for f in failures:
+            print(f"chaos selftest FAILED: {f}", file=sys.stderr)
+        return 1
+    print(f"chaos selftest: OK (world {WORLD} ring, spec "
+          f"'{SELFTEST_SPEC}': mean drift {drift:.2e}, "
+          f"{len(health_lines)} health lines, post-recovery residual "
+          f"{residual:.2e} < {residual_floor})")
+    return 0
+
+
+def _describe(spec: str, topology: str, world: int, ppi: int) -> int:
+    import numpy as np
+
+    from ..topology import TOPOLOGY_NAMES, build_schedule
+
+    if topology not in TOPOLOGY_NAMES:
+        print(f"chaos: unknown topology {topology!r}; one of "
+              f"{sorted(TOPOLOGY_NAMES)}", file=sys.stderr)
+        return 2
+    try:
+        plan = parse_fault_spec(spec)
+        sched = build_schedule(TOPOLOGY_NAMES[topology](
+            world, peers_per_itr=ppi))
+        masks = plan.build_masks(sched)
+    except ValueError as e:
+        print(f"chaos: error: {e}", file=sys.stderr)
+        return 2
+    print(f"fault plan for {topology} world={world} ppi={ppi}:")
+    print(f"  {plan.summary()}")
+    print(f"  mask rows: {masks.horizon} per-tick + {masks.num_phases} "
+          "steady-state (one per rotation phase)")
+    worst = 0.0
+    keep = masks.keep_host()
+    for t in range(masks.horizon):
+        w = plan.effective_matrix(sched, t)
+        dropped = int(round(float((1.0 - keep[t]).sum())))
+        col_err = float(np.abs(w.sum(axis=0) - 1.0).max())
+        worst = max(worst, col_err)
+        if dropped or t < 3:
+            print(f"  tick {t}: {dropped} dropped edge-message(s), "
+                  f"column-sum error {col_err:.2e}")
+    for p in range(masks.num_phases):
+        row = keep[masks.horizon + p]
+        dropped = int(round(float((1.0 - row).sum())))
+        if dropped:
+            print(f"  steady state, phase {p}: {dropped} dropped "
+                  "edge-message(s) (open-ended events)")
+    print(f"  worst column-sum error over the horizon: {worst:.2e} "
+          f"({'mass-conserving' if worst < 1e-9 else 'LEAKING MASS'})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="chaos",
+        description="Gossip fault injection: describe plans, run the "
+                    "resilience CI selftest")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the CI resilience self-check and exit")
+    ap.add_argument("--describe", default=None, metavar="SPEC",
+                    help="compile SPEC (faults.py grammar) and print the "
+                         "resulting mask tables' invariants")
+    ap.add_argument("--topology", default="ring",
+                    help="topology to compile --describe against")
+    ap.add_argument("--world", type=int, default=WORLD)
+    ap.add_argument("--ppi", type=int, default=1)
+    ap.add_argument("--residual_floor", type=float, default=0.01,
+                    help="selftest recovery floor")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest(args.residual_floor)
+    if args.describe:
+        return _describe(args.describe, args.topology, args.world,
+                         args.ppi)
+    ap.error("choose --selftest or --describe SPEC")
+    return 2

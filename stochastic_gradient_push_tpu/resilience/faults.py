@@ -1,0 +1,375 @@
+"""Deterministic fault injection at the gossip mixing boundary.
+
+SGP's pitch over AllReduce is robustness to stragglers and flaky links
+(Assran et al. 2018; GossipGraD, arxiv 1803.05880 motivates
+failure-tolerant gossip schedules) — but a claim of robustness is only
+worth what can be *reproduced*.  This module turns a textual fault
+specification into seeded, deterministic, jit-compatible mask tables that
+the collective layer applies inside the compiled gossip round.  No
+threads, no chaos-monkey processes, no host races: a fault plan is data,
+the same way a gossip schedule is data.
+
+Fault model (all faults are windows of the step counter, ``[t0, t1)``):
+
+* **edge drop** — a directed gossip edge ``src -> dst`` delivers nothing
+  whenever the rotation activates it inside the window;
+* **straggler** — a rank's *outgoing* messages all miss the deadline
+  (its peers gossip on without its contribution — the stale-partner
+  phase of a slow sender);
+* **blackout** — a rank neither sends nor receives (both edge
+  directions drop; the SPMD analogue of a temporarily dead host);
+* **NaN corruption** — a rank's outgoing *payloads* are replaced with
+  NaN (a poisoned wire; the monitor's non-finite guard must catch it —
+  the push-sum weight lane stays finite so ps-weight telemetry survives).
+
+**Mass-conserving drop semantics.**  Dropping a message naively would
+destroy push-sum's core invariant: the mixing matrix must stay
+column-stochastic for ``Σ params / Σ ps_weight`` to be the true network
+mean (analysis/verifier.py SGPV102).  Here, when an out-edge is dropped
+the *sender reabsorbs the undelivered mixing weight*: instead of keeping
+``lo·x`` and shipping ``w_i·x``, it keeps ``(lo + w_i)·x`` and ships
+nothing.  Every column of the effective matrix still sums to 1, so
+push-sum stays exactly mean-preserving under any fault plan — the
+invariant the chaos selftest (scripts/chaos.py) pins to float32
+tolerance.  :meth:`FaultPlan.effective_schedule` materializes the faulted
+tables in :class:`~..topology.schedule.GossipSchedule` form so
+``analysis.verify_schedule`` can check column-stochasticity directly.
+
+``reabsorb=False`` builds *naive* (mass-leaking) masks — never for
+training; it exists so tests can prove the runtime monitor detects a
+mass-leaking implementation within ``--health_every`` steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from ..topology.schedule import GossipSchedule
+
+__all__ = ["FaultEvent", "FaultPlan", "FaultMasks", "parse_fault_spec"]
+
+_KINDS = ("drop", "drop_random", "straggler", "blackout", "nan")
+
+# an open-ended window stays active forever: past the per-tick horizon
+# the compiled lookup switches to per-phase steady-state rows where only
+# open-ended events apply, resolved against each phase's own permutation
+_OPEN = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One fault clause: what breaks, for whom, over which step window."""
+
+    kind: str               # one of _KINDS
+    start: int              # first step (tick) the fault is active
+    end: int                # one past the last active step; _OPEN = forever
+    rank: int = -1          # subject rank (straggler/blackout/nan)
+    src: int = -1           # edge drop: sending rank
+    dst: int = -1           # edge drop: destination rank
+    prob: float = 0.0       # drop_random: per-edge per-step drop probability
+
+    def active(self, tick: int) -> bool:
+        return tick >= self.start and (self.end == _OPEN or tick < self.end)
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "start": self.start,
+             "end": None if self.end == _OPEN else self.end}
+        if self.kind == "drop":
+            d.update(src=self.src, dst=self.dst)
+        elif self.kind == "drop_random":
+            d["prob"] = self.prob
+        else:
+            d["rank"] = self.rank
+        return d
+
+
+def _parse_window(tail: str, kind: str) -> tuple[int, int]:
+    """``@T0:T1`` window suffix; missing = open-ended from step 0."""
+    if not tail:
+        if kind == "drop_random":
+            raise ValueError(
+                "drop_random requires a bounded @T0:T1 window (the "
+                "steady state past the horizon is deterministic)")
+        return 0, _OPEN
+    if ":" not in tail:
+        raise ValueError(f"fault window {tail!r} must be T0:T1")
+    lo, hi = tail.split(":", 1)
+    start, end = int(lo), int(hi)
+    if start < 0 or end <= start:
+        raise ValueError(f"fault window {tail!r} must satisfy 0 <= T0 < T1")
+    return start, end
+
+
+def parse_fault_spec(spec: str) -> "FaultPlan":
+    """Parse an ``--inject_faults`` specification into a :class:`FaultPlan`.
+
+    Grammar — semicolon-separated clauses, each ``kind:args[@T0:T1]``
+    with step windows ``[T0, T1)`` (omitted = from step 0, forever):
+
+    * ``drop:SRC->DST@T0:T1``   — drop the directed edge when active
+    * ``drop_random:P@T0:T1``   — drop each out-edge with probability P
+    * ``straggler:R@T0:T1``     — rank R's sends all miss
+    * ``blackout:R@T0:T1``      — rank R neither sends nor receives
+    * ``nan:R@T0:T1``           — rank R's outgoing payloads become NaN
+    * ``seed:N``                — PRNG seed for drop_random (default 0)
+
+    Example: ``drop:0->1@10:40;straggler:3@20:30;seed:7``.
+    """
+    events: list[FaultEvent] = []
+    seed = 0
+    for raw in spec.split(";"):
+        clause = raw.strip()
+        if not clause:
+            continue
+        if ":" not in clause:
+            raise ValueError(
+                f"fault clause {clause!r} must be kind:args[@T0:T1]")
+        kind, rest = clause.split(":", 1)
+        kind = kind.strip()
+        if kind == "seed":
+            seed = int(rest)
+            continue
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; one of {_KINDS} or seed")
+        body, _, window = rest.partition("@")
+        start, end = _parse_window(window, kind)
+        if kind == "drop":
+            if "->" not in body:
+                raise ValueError(
+                    f"drop needs SRC->DST, got {body!r}")
+            src, dst = body.split("->", 1)
+            events.append(FaultEvent(kind, start, end,
+                                     src=int(src), dst=int(dst)))
+        elif kind == "drop_random":
+            prob = float(body)
+            if not 0.0 <= prob <= 1.0:
+                raise ValueError(f"drop_random probability {prob} "
+                                 "outside [0, 1]")
+            events.append(FaultEvent(kind, start, end, prob=prob))
+        else:
+            events.append(FaultEvent(kind, start, end, rank=int(body)))
+    if not events:
+        raise ValueError(f"fault spec {spec!r} contains no fault clauses")
+    return FaultPlan(events=tuple(events), seed=seed)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A deterministic, seeded set of :class:`FaultEvent` windows.
+
+    Pure host-side data; :meth:`build_masks` compiles it against a
+    concrete :class:`GossipSchedule` into the device tables the
+    collective layer consumes.
+    """
+
+    events: tuple[FaultEvent, ...]
+    seed: int = 0
+
+    def horizon(self) -> int:
+        """Per-tick mask rows: one PAST the last bounded window, so the
+        lookup reaches the steady-state rows (only open-ended events
+        active, resolved per rotation phase) once every bounded fault
+        has ended."""
+        ends = [e.end + 1 for e in self.events if e.end != _OPEN]
+        starts = [e.start + 1 for e in self.events]
+        return max(ends + starts + [1])
+
+    def validate(self, world: int) -> None:
+        for e in self.events:
+            ranks = [r for r in (e.rank, e.src, e.dst) if r != -1]
+            for r in ranks:
+                if not 0 <= r < world:
+                    raise ValueError(
+                        f"fault {e.to_dict()} names rank {r} outside "
+                        f"world {world}")
+            if e.kind == "drop" and e.src == e.dst:
+                raise ValueError("drop edge must have src != dst")
+
+    # -- mask compilation --------------------------------------------------
+
+    def _apply_events(self, keep_row, corrupt_row, dests, ppi,
+                      events, rand_row) -> None:
+        """Mask one (phase-resolved) row in place for ``events``."""
+        for e in events:
+            if e.kind == "drop":
+                for i in range(ppi):
+                    if dests[i, e.src] == e.dst:
+                        keep_row[i, e.src] = 0.0
+            elif e.kind == "drop_random":
+                keep_row[rand_row < e.prob] = 0.0
+            elif e.kind == "straggler":
+                keep_row[:, e.rank] = 0.0
+            elif e.kind == "blackout":
+                keep_row[:, e.rank] = 0.0           # sends nothing
+                for i in range(ppi):                # receives nothing
+                    keep_row[i, dests[i] == e.rank] = 0.0
+            elif e.kind == "nan":
+                corrupt_row[e.rank] = 1.0
+
+    def _keep_corrupt_tables(self, schedule: GossipSchedule, horizon: int,
+                             gossip_every: int = 1
+                             ) -> tuple[np.ndarray, np.ndarray]:
+        """Host tables: keep ``(horizon + num_phases, ppi, world)`` and
+        corrupt ``(horizon + num_phases, world)`` float32.
+
+        Rows ``0..horizon-1`` resolve phase-dependent faults against the
+        permutation actually active at tick ``t`` — phase ``(t //
+        gossip_every) % num_phases``, matching the thinned rotation in
+        ``algorithms._thinned_post_step``.  Rows ``horizon + p`` are the
+        per-phase STEADY STATE past the horizon: only open-ended events
+        remain active, resolved against phase ``p``'s permutation — so an
+        open-ended ``drop:0->1`` keeps dropping exactly the 0→1 edge at
+        whichever phases carry it, never the whole out-neighborhood.
+        """
+        ppi, n = schedule.peers_per_itr, schedule.world_size
+        num_phases = schedule.num_phases
+        rows = horizon + num_phases
+        keep = np.ones((rows, ppi, n), dtype=np.float32)
+        corrupt = np.zeros((rows, n), dtype=np.float32)
+        rng = np.random.default_rng(self.seed)
+        # one deterministic random field for the whole horizon: the draw
+        # order never depends on which windows are active
+        rand = rng.random((horizon, ppi, n))
+        for t in range(horizon):
+            p = (t // gossip_every) % num_phases
+            active = [e for e in self.events if e.active(t)]
+            self._apply_events(keep[t], corrupt[t], schedule.perms[p],
+                               ppi, active, rand[t])
+        open_events = [e for e in self.events if e.end == _OPEN]
+        for p in range(num_phases):
+            self._apply_events(keep[horizon + p], corrupt[horizon + p],
+                               schedule.perms[p], ppi, open_events,
+                               # steady state is deterministic: random
+                               # drops require a bounded window
+                               np.ones((ppi, n)))
+        return keep, corrupt
+
+    def build_masks(self, schedule: GossipSchedule,
+                    reabsorb: bool = True,
+                    gossip_every: int = 1) -> "FaultMasks":
+        """Compile the plan against ``schedule`` into device mask tables.
+
+        ``gossip_every`` must match the algorithm's thinning factor: the
+        rotation phase at step ``t`` is ``(t // gossip_every) %
+        num_phases``, and phase-dependent faults (edge drops, blackout
+        receive sides) are resolved against the permutation actually
+        active at each tick.  The algorithm layer cross-checks this at
+        construction.
+
+        ``reabsorb=False`` builds mass-LEAKING masks (dropped weight
+        vanishes instead of returning to the sender) — only for tests
+        that prove the monitor detects broken implementations.
+        """
+        if gossip_every < 1:
+            raise ValueError("gossip_every must be >= 1")
+        self.validate(schedule.world_size)
+        horizon = self.horizon()
+        keep, corrupt = self._keep_corrupt_tables(schedule, horizon,
+                                                  gossip_every)
+        return FaultMasks(keep=keep, corrupt=corrupt, horizon=horizon,
+                          num_phases=schedule.num_phases,
+                          gossip_every=gossip_every,
+                          reabsorb=reabsorb, plan=self)
+
+    # -- verification helpers (host-side numpy, used by tests/chaos) ------
+
+    def effective_schedule(self, schedule: GossipSchedule, tick: int,
+                           gossip_every: int = 1) -> GossipSchedule:
+        """The faulted mixing tables at ``tick`` as a one-phase
+        :class:`GossipSchedule`: edge weights keep-masked, the dropped
+        mass reabsorbed into the self weight.  Feed it to
+        ``analysis.verify_schedule`` — SGPV102 (column-stochasticity)
+        passing is the algebraic statement that the fault plan is
+        mean-preserving.  Row selection mirrors the compiled lookup
+        (:meth:`FaultMasks._row`) exactly, terminal per-phase rows
+        included."""
+        horizon = self.horizon()
+        keep, _ = self._keep_corrupt_tables(schedule, horizon,
+                                            gossip_every)
+        p = (tick // gossip_every) % schedule.num_phases
+        row = tick if tick < horizon else horizon + p
+        k = keep[row]                          # (ppi, world)
+        edge_w = schedule.edge_weights[p] * k
+        self_w = (schedule.self_weight[p]
+                  + (schedule.edge_weights[p] * (1.0 - k)).sum(axis=0))
+        return GossipSchedule(
+            perms=schedule.perms[p][None],
+            self_weight=self_w[None],
+            edge_weights=edge_w[None],
+            regular=False,
+            world_size=schedule.world_size,
+            peers_per_itr=schedule.peers_per_itr,
+            num_phases=1)
+
+    def effective_matrix(self, schedule: GossipSchedule, tick: int,
+                         gossip_every: int = 1) -> np.ndarray:
+        """Dense column-stochastic mixing matrix actually applied at
+        ``tick`` under this plan (mass-conserving semantics)."""
+        return self.effective_schedule(schedule, tick,
+                                       gossip_every).mixing_matrix(0)
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed,
+                "events": [e.to_dict() for e in self.events]}
+
+    def summary(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+class FaultMasks:
+    """Device-resident mask tables for one (plan, schedule) pair.
+
+    ``keep_at``/``corrupt_at`` are called from inside the compiled gossip
+    round with a *traced* tick.  The table holds one row per tick up to
+    the plan's horizon plus ``num_phases`` terminal rows (the per-phase
+    steady state where only open-ended events remain active); the lookup
+    is a dynamic gather on ``tick`` within the horizon and on
+    ``horizon + phase(tick)`` past it, so bounded windows END and
+    open-ended phase-dependent faults keep hitting the RIGHT edges as
+    the rotation cycles.
+    """
+
+    def __init__(self, keep: np.ndarray, corrupt: np.ndarray,
+                 horizon: int, num_phases: int, gossip_every: int,
+                 reabsorb: bool, plan: FaultPlan):
+        import jax.numpy as jnp
+
+        self.horizon = int(horizon)
+        self.num_phases = int(num_phases)
+        self.gossip_every = int(gossip_every)
+        self.reabsorb = bool(reabsorb)
+        self.plan = plan
+        self.any_corruption = bool(corrupt.any())
+        self._keep = jnp.asarray(keep)        # (horizon+phases, ppi, world)
+        self._corrupt = jnp.asarray(corrupt)  # (horizon+phases, world)
+
+    def keep_host(self) -> np.ndarray:
+        """Host copy of the keep table ``(horizon + num_phases, ppi,
+        world)`` — reporting/tests only, never the compiled path."""
+        return np.asarray(self._keep)
+
+    def _row(self, tick):
+        import jax.numpy as jnp
+
+        t = jnp.asarray(tick, jnp.int32)
+        phase = (t // self.gossip_every) % self.num_phases
+        return jnp.where(t < self.horizon, t, self.horizon + phase)
+
+    def keep_at(self, tick, sub_round: int, axis_name: str):
+        """Traced scalar in {0, 1}: does this rank's ``sub_round``-th
+        message go out at ``tick``?"""
+        from jax import lax
+
+        return self._keep[self._row(tick), sub_round,
+                          lax.axis_index(axis_name)]
+
+    def corrupt_at(self, tick, axis_name: str):
+        """Traced scalar in {0, 1}: are this rank's outgoing payloads
+        NaN-poisoned at ``tick``?"""
+        from jax import lax
+
+        return self._corrupt[self._row(tick), lax.axis_index(axis_name)]

@@ -1,0 +1,213 @@
+"""Runtime consensus health: in-graph signals + a host-side monitor.
+
+PR 2's planner decides everything at *launch*; nothing in the repo could
+see a mesh degrade at *runtime*.  This module is the seeing half of the
+resilience loop (recovery.py is the acting half):
+
+* :func:`health_signals` — a handful of cheap reductions computed INSIDE
+  the compiled train step (they ride the metrics pytree, no extra host
+  round-trip): push-sum weight min/max, total-mass error, non-finite
+  element counts, and a consensus-residual estimate on a probe slice of
+  the de-biased parameters (same ``‖x − x̄‖`` semantics as
+  ``parallel/averaging.py:consensus_error``, but collective — a psum over
+  the gossip axis — instead of a host gather of the full state);
+* :class:`HealthMonitor` — host-side: consumes the fetched signals,
+  emits structured JSONL ``gossip health:`` lines (matching the
+  ``gossip plan:`` convention so one grep collects the whole telemetry
+  stream), tracks step-time p50/p99 through a bounded
+  :class:`~..utils.meter.PercentileMeter` (straggler skew), and flags
+  excursions for the recovery policy.
+
+Why these signals detect what they detect:
+
+* ``ps_mass_err`` — column-stochastic mixing preserves ``Σ ps_weight``
+  exactly, so ``|Σw/n − 1|`` growing from float-noise to O(edge weight)
+  is the signature of a *mass-leaking* implementation (a dropped message
+  whose weight nobody reabsorbed).  The regression test pins that naive
+  dropping is caught within one ``--health_every`` window.
+* ``ps_w_min`` collapsing toward 0 — a rank that keeps sending but
+  stops *receiving* mass (dead in-edges) bleeds weight every round.
+* ``consensus_residual`` — rising residual means the graph is no longer
+  mixing fast enough (dropped edges, partition, below-floor topology);
+  this is the signal recovery compares against ``--residual_floor``.
+* ``nonfinite_params/grads`` — NaN/Inf anywhere in the network; with a
+  corrupted wire the poison arrives through gossip, so the count is
+  psum'd to make every rank see it the same step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing as tp
+
+from ..utils.meter import PercentileMeter
+
+__all__ = ["health_signals", "HealthMonitor", "HealthReport",
+           "HEALTH_KEYS"]
+
+# every key health_signals emits, in the order the JSONL line reports them
+HEALTH_KEYS = ("consensus_residual", "ps_w_min", "ps_w_max", "ps_mass_err",
+               "nonfinite_params", "nonfinite_grads")
+
+DEFAULT_PROBE_SLOTS = 256
+
+# a push-sum weight this close to zero means the rank has effectively
+# stopped receiving mass (its de-bias division is about to explode)
+DEFAULT_PS_WEIGHT_FLOOR = 1e-2
+
+# tolerance on |Σw/n - 1|: float32 gossip keeps the total exact to
+# ~1e-6/round, so anything past this is a real leak, not rounding
+DEFAULT_MASS_TOL = 1e-3
+
+
+def _probe_leaf(params):
+    """Deterministic probe: the largest parameter leaf (ties broken by
+    tree order), raveled.  Large leaves dominate consensus error and a
+    fixed choice keeps the signal comparable across steps."""
+    import jax
+
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        raise ValueError("health_signals needs at least one param leaf")
+    best = max(range(len(leaves)), key=lambda i: leaves[i].size)
+    return leaves[best].reshape(-1)
+
+
+def health_signals(params, grads, ps_weight, axis_name: str,
+                   probe_slots: int = DEFAULT_PROBE_SLOTS) -> dict:
+    """In-graph health reductions; call inside the compiled step (within
+    shard_map) AFTER ``post_step``.  Returns float32 scalars that are
+    identical on every rank (each is a collective over ``axis_name``), so
+    the host can read any one shard.
+
+    Cost: two scalar psums, one pmin/pmax pair, one ``probe_slots``-wide
+    pmean+psum, and one elementwise isfinite sweep — noise next to a
+    forward/backward.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel.collectives import as_scalar
+
+    w = as_scalar(ps_weight).astype(jnp.float32)
+    world = lax.axis_size(axis_name)
+
+    def nonfinite_count(tree):
+        total = jnp.float32(0.0)
+        for leaf in jax.tree.leaves(tree):
+            total = total + jnp.sum(
+                ~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.float32)
+        return lax.psum(total, axis_name)
+
+    probe = _probe_leaf(params)
+    slots = min(probe_slots, probe.size)
+    probe = probe[:slots].astype(jnp.float32) / w   # de-biased view
+    center = lax.pmean(probe, axis_name)
+    residual = jnp.sqrt(
+        lax.psum(jnp.sum((probe - center) ** 2), axis_name)
+        / (world * slots))
+
+    return {
+        "consensus_residual": residual,
+        "ps_w_min": lax.pmin(w, axis_name),
+        "ps_w_max": lax.pmax(w, axis_name),
+        "ps_mass_err": jnp.abs(lax.psum(w, axis_name) / world - 1.0),
+        "nonfinite_params": nonfinite_count(params),
+        "nonfinite_grads": (nonfinite_count(grads)
+                            if grads is not None else jnp.float32(0.0)),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthReport:
+    """One observed health snapshot plus the monitor's verdict."""
+
+    step: int
+    payload: dict
+    reasons: tuple[str, ...]
+
+    @property
+    def unhealthy(self) -> bool:
+        return bool(self.reasons)
+
+
+class HealthMonitor:
+    """Host-side consumer of :func:`health_signals` outputs.
+
+    Emits one structured ``gossip health: {json}`` line every
+    ``health_every`` observed steps — and immediately on any excursion,
+    so a fault never waits for the cadence to be seen.  ``last_payload``
+    is what the trainer stamps into checkpoint metadata (the run's
+    health at save time rides with the state it describes).
+    """
+
+    def __init__(self, health_every: int = 100,
+                 residual_floor: float = 0.01,
+                 mass_tol: float = DEFAULT_MASS_TOL,
+                 ps_weight_floor: float = DEFAULT_PS_WEIGHT_FLOOR,
+                 log=None, step_window: int = 1024):
+        if health_every < 1:
+            raise ValueError("health_every must be >= 1")
+        self.health_every = health_every
+        self.residual_floor = residual_floor
+        self.mass_tol = mass_tol
+        self.ps_weight_floor = ps_weight_floor
+        self.log = log
+        self.step_time = PercentileMeter(maxlen=step_window, ptag="Step")
+        self.last_payload: dict | None = None
+        self.reports: int = 0
+        self.excursions: int = 0
+
+    def record_step_time(self, seconds: float) -> None:
+        self.step_time.update(seconds)
+
+    def _diagnose(self, sig: tp.Mapping[str, float]) -> tuple[str, ...]:
+        reasons = []
+        if sig["consensus_residual"] > self.residual_floor \
+                or not sig["consensus_residual"] == sig["consensus_residual"]:
+            # NaN residual counts as an excursion (poisoned probe)
+            reasons.append("residual-above-floor")
+        if sig["ps_mass_err"] > self.mass_tol \
+                or sig["ps_mass_err"] != sig["ps_mass_err"]:
+            reasons.append("push-sum-mass-leak")
+        if sig["ps_w_min"] < self.ps_weight_floor:
+            reasons.append("ps-weight-collapse")
+        if sig["nonfinite_params"] > 0 or \
+                sig["nonfinite_params"] != sig["nonfinite_params"]:
+            reasons.append("nonfinite-params")
+        if sig["nonfinite_grads"] > 0 or \
+                sig["nonfinite_grads"] != sig["nonfinite_grads"]:
+            reasons.append("nonfinite-grads")
+        return tuple(reasons)
+
+    def observe(self, step: int, signals: tp.Mapping[str, tp.Any]
+                ) -> HealthReport:
+        """Digest one step's fetched signals; returns the report (the
+        recovery policy consumes it).  Logging happens here so every
+        emitted line went through the same diagnosis."""
+        sig = {k: float(signals[k]) for k in HEALTH_KEYS}
+        reasons = self._diagnose(sig)
+        payload = {"step": int(step),
+                   **{k: round(sig[k], 8) for k in HEALTH_KEYS},
+                   "residual_floor": self.residual_floor,
+                   "step_p50_s": round(self.step_time.p50, 5),
+                   "step_p99_s": round(self.step_time.p99, 5)}
+        if reasons:
+            payload["reasons"] = list(reasons)
+        self.last_payload = payload
+        report = HealthReport(step=int(step), payload=payload,
+                              reasons=reasons)
+        due = step % self.health_every == 0
+        if self.log is not None and (due or reasons):
+            line = "gossip health: " + json.dumps(payload, sort_keys=True)
+            if reasons:
+                self.log.warning(line)
+            else:
+                self.log.info(line)
+        if due or reasons:
+            self.reports += 1
+        if reasons:
+            self.excursions += 1
+        return report

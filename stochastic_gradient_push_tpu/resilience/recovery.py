@@ -1,0 +1,173 @@
+"""Self-healing gossip recovery: close the monitor → planner loop.
+
+The monitor (monitor.py) sees divergence; this module acts on it.  The
+recovery primitive is the one Chen et al. (arxiv 2105.09080) supply and
+PR 2 already wired in as ``global_avg_every``: an *exact* global average
+``x ← Σ params / Σ ps_weight`` with a ps-weight reset — mean-preserving
+under any column-stochastic mixing (faulted included), consensus
+residual snaps to zero in ONE collective.  Instead of only firing it on
+a fixed launch-time period, :class:`RecoveryPolicy` fires it on demand:
+when the consensus residual crosses ``--residual_floor``, when push-sum
+mass leaks, or when a rank's ps-weight collapses (dead in-edges).
+
+On every firing the policy also *re-plans*: it asks
+``planner.plan_for`` what topology it would choose for this world now,
+and logs the suggestion in the structured ``gossip recovery:`` line —
+if the current graph keeps tripping the floor, the operator (or a
+restart script grepping the JSONL stream) has the switch spelled out,
+gap and averaging period included.  The SPMD program itself cannot drop
+ranks mid-run (a compiled mesh is fixed), so topology *switching* is a
+relaunch decision; making it a logged, machine-readable suggestion is
+what closes the loop without pretending otherwise.
+
+NaN/Inf excursions deliberately do NOT trigger the average: psum spreads
+poison, it never removes it.  They are logged with a
+``checkpoint-restore`` hint instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from ..parallel.mesh import GOSSIP_AXIS
+from .monitor import HealthReport
+
+__all__ = ["RecoveryPolicy", "RecoveryEvent", "make_recovery_fn"]
+
+# reasons the exact-average primitive can actually repair
+_AVERAGEABLE = ("residual-above-floor", "push-sum-mass-leak",
+                "ps-weight-collapse")
+_POISONED = ("nonfinite-params", "nonfinite-grads")
+
+
+def make_recovery_fn(algorithm, mesh, axis_name: str = GOSSIP_AXIS):
+    """Compile ``algorithm.global_average`` for a world-stacked state.
+
+    Returns ``(params, ps_weight) -> (params, ps_weight)`` over arrays
+    whose leading dimension is the gossip world (the trainer's state
+    layout): one allreduce, de-bias, ps-weight reset to 1.  Reuses the
+    exact machinery of the in-step periodic average
+    (algorithms.PushSumGossip.global_average), so the recovery action
+    and the planned schedule can never drift apart semantically.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if not hasattr(algorithm, "global_average"):
+        raise ValueError(
+            f"{type(algorithm).__name__} has no global_average; recovery "
+            "applies to the push-sum/D-PSGD gossip family")
+    if getattr(algorithm, "overlap", False):
+        # same invariant as global_avg_every: averaging around in-flight
+        # overlap shares would double-count them
+        raise ValueError(
+            "recovery global-average is a synchronous-mode action: "
+            "overlap in-flight shares would be double-counted")
+
+    def run(params, ps_weight):
+        squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
+        p, w = algorithm.global_average(squeeze(params), squeeze(ps_weight))
+        unsqueeze = lambda t: jax.tree.map(lambda a: a[None], t)
+        return unsqueeze(p), unsqueeze(w)
+
+    return jax.jit(jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name))))
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryEvent:
+    """One recovery decision, as logged."""
+
+    step: int
+    action: str              # "global-average" | "advise-restore" | "none"
+    reasons: tuple[str, ...]
+    suggestion: dict | None  # planner re-plan for this world, if consulted
+
+    def to_dict(self) -> dict:
+        d = {"step": self.step, "action": self.action,
+             "reasons": list(self.reasons)}
+        if self.suggestion is not None:
+            d["suggestion"] = self.suggestion
+        return d
+
+
+class RecoveryPolicy:
+    """Decides when the trainer fires an immediate exact global average.
+
+    ``cooldown_steps`` bounds the firing rate: one global average snaps
+    the residual to zero, so re-triggering before fresh gossip rounds
+    have run would only measure float noise.  ``max_recoveries`` (0 =
+    unlimited) is the circuit breaker for a permanently broken mesh —
+    after it trips, the policy stops averaging and keeps logging.
+    """
+
+    def __init__(self, world: int, ppi: int = 1, algorithm: str = "sgp",
+                 topology: str | None = None,
+                 residual_floor: float = 0.01,
+                 cooldown_steps: int = 10,
+                 max_recoveries: int = 0, log=None):
+        self.world = world
+        self.ppi = ppi
+        self.algorithm = algorithm
+        self.topology = topology          # current graph, for the diff
+        self.residual_floor = residual_floor
+        self.cooldown_steps = max(0, cooldown_steps)
+        self.max_recoveries = max_recoveries
+        self.log = log
+        self.recoveries = 0
+        self.last_fired_step: int | None = None
+        self.events: list[RecoveryEvent] = []
+
+    # -- planner consultation ---------------------------------------------
+
+    def replan(self) -> dict:
+        """Ask the planner what it would run for this world NOW; returns
+        a JSON-safe suggestion {topology, gap, global_avg_every, switch}.
+        ``switch`` is True when the suggestion differs from the running
+        topology — the relaunch hint."""
+        from ..planner import plan_for
+
+        plan = plan_for(self.world, ppi=self.ppi, algorithm=self.algorithm)
+        return {"topology": plan.topology, "ppi": plan.ppi,
+                "gap": round(plan.gap, 6),
+                "global_avg_every": plan.global_avg_every,
+                "switch": (self.topology is not None
+                           and plan.topology != self.topology)}
+
+    # -- decision ----------------------------------------------------------
+
+    def _in_cooldown(self, step: int) -> bool:
+        return (self.last_fired_step is not None
+                and step - self.last_fired_step < self.cooldown_steps)
+
+    def assess(self, report: HealthReport) -> RecoveryEvent:
+        """Turn a health report into a recovery decision (and log it).
+        ``action == "global-average"`` tells the trainer to run its
+        compiled recovery fn and reset the gossip state's ps-weight."""
+        poisoned = [r for r in report.reasons if r in _POISONED]
+        fixable = [r for r in report.reasons if r in _AVERAGEABLE]
+        if poisoned:
+            # averaging spreads NaN; restoring a pre-poison checkpoint is
+            # the only sound repair
+            event = RecoveryEvent(report.step, "advise-restore",
+                                  tuple(poisoned + fixable), None)
+        elif (fixable and not self._in_cooldown(report.step)
+              and (self.max_recoveries == 0
+                   or self.recoveries < self.max_recoveries)):
+            event = RecoveryEvent(report.step, "global-average",
+                                  tuple(fixable), self.replan())
+            self.recoveries += 1
+            self.last_fired_step = report.step
+        else:
+            event = RecoveryEvent(report.step, "none",
+                                  tuple(report.reasons), None)
+        if event.action != "none":
+            self.events.append(event)
+            if self.log is not None:
+                self.log.warning("gossip recovery: "
+                                 + json.dumps(event.to_dict(),
+                                              sort_keys=True))
+        return event

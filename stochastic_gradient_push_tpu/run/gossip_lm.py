@@ -53,6 +53,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "the planner decides (enabled when no gossip "
                         "graph clears the gap floor), 0 = explicitly "
                         "off, k = force every-k averaging")
+    p.add_argument("--mixing_alpha", default=None, type=str,
+                   help="SelfWeightedMixing self-mass: 'auto' co-"
+                        "optimizes alpha against the chosen topology "
+                        "(planner scalar search); a float in (0,1) "
+                        "forces it (with a warning when co-optimization "
+                        "would recover >10%% of the gap); unset = "
+                        "uniform mixing")
+    p.add_argument("--inject_faults", default=None, type=str,
+                   help="deterministic fault injection at the gossip "
+                        "boundary (resilience/faults.py grammar, e.g. "
+                        "'drop:0->1@10:40;straggler:3@20:30;seed:7'); "
+                        "mass-conserving drop semantics, push-sum "
+                        "synchronous mode only")
+    p.add_argument("--health_every", default=0, type=int,
+                   help="emit a structured 'gossip health:' line every k "
+                        "steps; excursions arm the recovery policy "
+                        "(immediate exact global average); flat dp/sp "
+                        "meshes only; 0 disables")
+    p.add_argument("--residual_floor", default=0.01, type=float,
+                   help="consensus-residual level above which recovery "
+                        "fires an immediate exact global average "
+                        "(requires --health_every > 0)")
     p.add_argument("--peers_per_itr", default=1, type=int)
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step (communication thinning)")
@@ -198,7 +220,8 @@ def main(argv=None):
                             shard_lm_train_step)
     from ..train.lr import WARMUP_EPOCHS
     from ..utils import Meter, make_logger
-    from .gossip_sgd import _multihost_env, _str_bool as sb
+    from .gossip_sgd import (_multihost_env, _parse_mixing_alpha,
+                             _str_bool as sb)
 
     want_mh = args.multihost
     if want_mh == "True" or (want_mh == "auto" and _multihost_env()):
@@ -260,6 +283,49 @@ def main(argv=None):
     if args.seq_len % sp:
         raise SystemExit(f"seq_len {args.seq_len} not divisible by sp {sp}")
 
+    # resilience/mixing flag validation (same error text as gossip_sgd,
+    # fail before any device work)
+    args.mixing_alpha = _parse_mixing_alpha(args.mixing_alpha)
+    if args.mixing_alpha is not None and (
+            sb(args.all_reduce) or not sb(args.push_sum)):
+        raise SystemExit("--mixing_alpha needs push-sum gossip: AllReduce "
+                         "doesn't mix, and D-PSGD requires a regular "
+                         "(doubly-stochastic) schedule")
+    if args.mixing_alpha is not None and (sb(args.bilat) or dp < 2):
+        raise SystemExit("--topology auto / --mixing_alpha plan "
+                         "gossip schedules; they do not apply to "
+                         "all_reduce/bilateral modes or a "
+                         "single-rank world")
+    if args.inject_faults:
+        if sb(args.all_reduce) or sb(args.bilat) \
+                or not sb(args.push_sum):
+            raise SystemExit("--inject_faults needs push-sum gossip: only "
+                             "push-sum's mass accounting keeps the mean "
+                             "exact under dropped edges")
+        if sb(args.overlap):
+            raise SystemExit("--inject_faults is a synchronous-mode "
+                             "feature: overlap in-flight shares would "
+                             "straddle fault windows")
+        from ..resilience import parse_fault_spec
+
+        fault_plan = parse_fault_spec(args.inject_faults)
+    else:
+        fault_plan = None
+    if args.health_every < 0:
+        raise SystemExit("--health_every must be >= 0")
+    if args.health_every:
+        if ep > 1 or tp > 1 or pp > 1:
+            # ep shards hold different expert slices (health signals
+            # would vary over ep and break metrics replication); tp's
+            # auto axis and pp's staged step are likewise health-opaque
+            raise SystemExit("--health_every composes with the flat dp "
+                             "and dp×sp meshes only (not ep/tp/pp)")
+        if args.health_every % args.print_freq:
+            raise SystemExit(
+                f"--health_every {args.health_every} must be a multiple "
+                f"of --print_freq {args.print_freq} (health signals ride "
+                "the metrics fetch cadence)")
+
     # launch-time topology policy BEFORE any mesh/device work (planning is
     # pure numpy, and a below-floor warning must reach the user even when
     # the launch subsequently fails): the gossip world for the LM is the
@@ -273,6 +339,8 @@ def main(argv=None):
             graph_class=GRAPH_TOPOLOGIES[args.graph_type],
             floor=args.gap_floor,
             algorithm="sgp" if sb(args.push_sum) else "dpsgd",
+            self_weighted=(True if args.mixing_alpha == "auto"
+                           else (args.mixing_alpha or False)),
             global_avg_every=args.global_avg_every,  # None = policy
             log=log)
     elif args.topology is not None and (sb(args.all_reduce)
@@ -454,18 +522,24 @@ def main(argv=None):
             graph, plan.mixing_strategy() if plan is not None else None)
         gae = plan.global_avg_every if plan is not None \
             else (args.global_avg_every or 0)
+        faults = None
+        if fault_plan is not None:
+            # compiled against THIS schedule: masks are per-(phase, edge)
+            faults = fault_plan.build_masks(
+                schedule, gossip_every=args.gossip_every)
+            log.warning("gossip faults: %s", fault_plan.summary())
         if sb(args.push_sum):
             comm_dtype = (jnp.bfloat16 if args.gossip_comm_dtype == "bf16"
                           else None)
             alg = sgp(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
                       gossip_every=args.gossip_every, comm_dtype=comm_dtype,
-                      global_avg_every=gae)
+                      global_avg_every=gae, faults=faults)
         else:
             if args.gossip_every != 1 or args.gossip_comm_dtype:
                 raise SystemExit(
                     "gossip_every/gossip_comm_dtype are push-sum knobs")
             alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
-                        global_avg_every=gae)
+                        global_avg_every=gae, faults=faults)
 
     tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
              nesterov=sb(args.nesterov))
@@ -498,7 +572,8 @@ def main(argv=None):
             model, alg, tx, lrs, itr_per_epoch=itr_per_epoch,
             seq_axis=SEQ_AXIS if ring_family else None,
             ep_axis=EP_AXIS if ep > 1 else None,
-            grad_accum=args.grad_accum)
+            grad_accum=args.grad_accum,
+            health_axis=GOSSIP_AXIS if args.health_every > 0 else None)
         if ep > 1:
             state = init_lm_state_ep(model, mesh, alg, tx, dp=dp, ep=ep,
                                      batch_size=args.batch_size,
@@ -629,6 +704,10 @@ def main(argv=None):
             # reproducibility: the launch-time topology plan rides with
             # the state it shaped
             meta["plan"] = plan.to_dict()
+        if monitor is not None and monitor.last_payload:
+            # the run's consensus health at save time rides with the
+            # state it describes (resilience/monitor.py)
+            meta["health"] = monitor.last_payload
         if use_orbax:
             # orbax steps are keyed by id: pass the step explicitly (the
             # live sharded state on pods, host conversion single-process)
@@ -705,6 +784,31 @@ def main(argv=None):
                              rank=proc_index)
                 if args.heartbeat_timeout > 0 else None)
     prints_done = 0
+
+    # runtime consensus health (resilience/): signals ride the metrics
+    # pytree every step and are observed at the print cadence (the only
+    # points the LM loop fetches metrics — dispatch stays asynchronous)
+    monitor = policy = recovery = None
+    if args.health_every > 0:
+        from ..resilience import (HealthMonitor, RecoveryPolicy,
+                                  make_recovery_fn)
+
+        monitor = HealthMonitor(health_every=args.health_every,
+                                residual_floor=args.residual_floor,
+                                log=log)
+        # (fetch time, steps_done, val_time) at the previous metrics
+        # fetch — step-time samples are per-WINDOW deltas, so a straggler
+        # phase moves p99 instead of dissolving into the lifetime mean
+        health_window_start = None
+        if dp > 1 and hasattr(alg, "global_average") \
+                and not sb(args.overlap):
+            policy = RecoveryPolicy(
+                world=dp, ppi=args.peers_per_itr,
+                algorithm="sgp" if sb(args.push_sum) else "dpsgd",
+                topology=plan.topology if plan is not None else None,
+                residual_floor=args.residual_floor,
+                cooldown_steps=args.health_every, log=log)
+            recovery = make_recovery_fn(alg, mesh)
 
     loss_meter = Meter(ptag="Loss")
     steps_done = start_step
@@ -824,6 +928,34 @@ def main(argv=None):
                 with guard:
                     mh = host_metrics(metrics)
                 prints_done += 1
+                if monitor is not None:
+                    from ..resilience.monitor import HEALTH_KEYS
+
+                    # one sample per fetch window: the window's own
+                    # average step time (validation time excluded), NOT
+                    # the cumulative run average.  The first window is
+                    # skipped — it carries the XLA compile.
+                    now = time.time()
+                    if health_window_start is not None:
+                        t_prev, s_prev, v_prev = health_window_start
+                        steps_in_window = steps_done - s_prev
+                        if steps_in_window > 0:
+                            elapsed = (now - t_prev) - (val_time - v_prev)
+                            monitor.record_step_time(
+                                max(0.0, elapsed) / steps_in_window)
+                    health_window_start = (now, steps_done, val_time)
+                    sig = {k: float(np.asarray(mh[k]).ravel()[0])
+                           for k in HEALTH_KEYS}
+                    report = monitor.observe(steps_done, sig)
+                    if report.unhealthy and policy is not None:
+                        event = policy.assess(report)
+                        if event.action == "global-average":
+                            new_p, new_w = recovery(
+                                state.params, state.gossip.ps_weight)
+                            state = state.replace(
+                                params=new_p,
+                                gossip=state.gossip.replace(
+                                    ps_weight=new_w))
                 loss = float(np.mean(mh["loss"]))
                 loss_meter.update(loss)
                 tps = (tokens_per_step * (steps_done - start_step)

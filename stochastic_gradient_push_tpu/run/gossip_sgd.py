@@ -96,6 +96,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "forces it (with a warning when co-optimization "
                         "would recover >10%% of the gap); unset = "
                         "uniform mixing")
+    p.add_argument("--inject_faults", default=None, type=str,
+                   help="deterministic fault injection at the gossip "
+                        "boundary (resilience/faults.py grammar, e.g. "
+                        "'drop:0->1@10:40;straggler:3@20:30;seed:7'); "
+                        "mass-conserving drop semantics, push-sum "
+                        "synchronous mode only")
+    p.add_argument("--health_every", default=0, type=int,
+                   help="emit a structured 'gossip health:' line every k "
+                        "steps (ps-weight drift, push-sum mass error, "
+                        "NaN guards, consensus residual, step-time "
+                        "p50/p99); excursions log immediately and arm "
+                        "the recovery policy; 0 disables")
+    p.add_argument("--residual_floor", default=0.01, type=float,
+                   help="consensus-residual level above which recovery "
+                        "fires an immediate exact global average "
+                        "(requires --health_every > 0)")
     p.add_argument("--mixing_strategy", default=0, type=int,
                    choices=list(MIXING_STRATEGIES))
     p.add_argument("--schedule", nargs="+", default=[30, 0.1, 60, 0.1, 80, 0.1],
@@ -228,6 +244,21 @@ def parse_config(argv=None):
         raise SystemExit("--mixing_alpha needs push-sum gossip: AllReduce "
                          "doesn't mix, and D-PSGD requires a regular "
                          "(doubly-stochastic) schedule")
+    if args.inject_faults:
+        if all_reduce or not _str_bool(args.push_sum):
+            raise SystemExit("--inject_faults needs push-sum gossip: only "
+                             "push-sum's mass accounting keeps the mean "
+                             "exact under dropped edges")
+        if _str_bool(args.overlap):
+            raise SystemExit("--inject_faults is a synchronous-mode "
+                             "feature: overlap in-flight shares would "
+                             "straddle fault windows")
+        # fail at parse time, not at first compiled step
+        from ..resilience import parse_fault_spec
+
+        parse_fault_spec(args.inject_faults)
+    if args.health_every < 0:
+        raise SystemExit("--health_every must be >= 0")
     # a forced name overrides the integer registry; 'auto' is resolved in
     # main() once the world size is known (planner.resolve_topology)
     graph_class = GRAPH_TOPOLOGIES[args.graph_type]
@@ -275,6 +306,9 @@ def parse_config(argv=None):
         per_rank_csv=_str_bool(args.per_rank_csv),
         heartbeat_timeout=args.heartbeat_timeout,
         global_avg_every=args.global_avg_every or 0,
+        inject_faults=args.inject_faults,
+        health_every=args.health_every,
+        residual_floor=args.residual_floor,
     )
     return cfg, args
 

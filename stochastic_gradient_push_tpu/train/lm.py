@@ -291,7 +291,8 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                         seq_axis: str | None = SEQ_AXIS,
                         ep_axis: str | None = None,
                         moe_loss_coef: float = 0.01,
-                        grad_accum: int = 1) -> tp.Callable:
+                        grad_accum: int = 1,
+                        health_axis: str | None = None) -> tp.Callable:
     """Per-rank LM step ``(state, tokens, targets) -> (state, metrics)``.
 
     Same four-slot structure as the image step (train/step.py); loss is
@@ -411,6 +412,16 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                 gn = lax.pmean(gn, ax)
         metrics = {"loss": loss, "ppl": jnp.exp(ce), "lr": lr,
                    "moe_dropped": dropped, "grad_norm": gn}
+        if health_axis is not None:
+            # consensus health AFTER the gossip round (resilience/):
+            # each signal is a collective over the gossip axis and — on a
+            # dp×sp mesh — seq-invariant, since params and the seq-psummed
+            # grads are replicated over seq.  (ep shards hold different
+            # expert slices, so health composes with the flat dp/sp
+            # meshes only; the CLI enforces that.)
+            from ..resilience.monitor import health_signals
+            metrics.update(health_signals(
+                params, grads, gstate.ps_weight, health_axis))
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 

@@ -138,6 +138,19 @@ class TrainerConfig:
     # reference's per-process files); off = one rank-averaged out_r0 file
     per_rank_csv: bool = False
 
+    # -- resilience (resilience/) -----------------------------------------
+    # deterministic fault injection at the gossip mixing boundary
+    # (resilience/faults.py spec grammar, e.g. "drop:0->1@10:40");
+    # push-sum sync mode only, mass-conserving drop semantics
+    inject_faults: str | None = None
+    # consensus health telemetry cadence: compute in-step health signals
+    # and emit a structured `gossip health:` line every k steps (plus
+    # immediately on any excursion); 0 disables monitoring entirely
+    health_every: int = 0
+    # consensus-residual level (RMS over the de-biased probe slice) above
+    # which the recovery policy fires an immediate exact global average
+    residual_floor: float = 0.01
+
 
 class Trainer:
     """Drives training of ``model`` over ``mesh`` with the configured
@@ -202,6 +215,36 @@ class Trainer:
         self._async_bilat = None  # built per-fit when cfg.bilat_async
         self._warned_prefetch = False
 
+        # runtime consensus health (resilience/): monitor sees, policy
+        # decides, the compiled recovery fn (cached per algorithm) acts
+        self.monitor = None
+        self.recovery_policy = None
+        self._recovery_cache: dict = {}
+        if config.health_every > 0:
+            from ..resilience import HealthMonitor, RecoveryPolicy
+
+            self.monitor = HealthMonitor(
+                health_every=config.health_every,
+                residual_floor=config.residual_floor, log=self.log)
+            if not (config.all_reduce or config.bilat
+                    or config.bilat_async or config.overlap):
+                # overlap mode monitors but never auto-averages (the
+                # in-flight shares would be double-counted); the health
+                # stream still flags excursions for the operator
+                from ..topology import topology_name
+
+                try:
+                    topo = topology_name(config.graph_class)
+                except KeyError:
+                    topo = None
+                self.recovery_policy = RecoveryPolicy(
+                    world=self.gossip_world,
+                    ppi=ppi_at_epoch(config.ppi_schedule, 0),
+                    algorithm="sgp" if config.push_sum else "dpsgd",
+                    topology=topo,
+                    residual_floor=config.residual_floor,
+                    cooldown_steps=config.health_every, log=self.log)
+
         # per-rank files: each process writes its local ranks; the single
         # aggregate file is process 0's job
         self._csv_ranks = (tuple(self.local_ranks) if config.per_rank_csv
@@ -237,6 +280,11 @@ class Trainer:
             raise ValueError(
                 "global_avg_every applies to the push-sum/D-PSGD gossip "
                 "family (all_reduce is already exact every step)")
+        if cfg.inject_faults and (cfg.all_reduce or cfg.bilat
+                                  or cfg.bilat_async):
+            raise ValueError(
+                "inject_faults breaks gossip edges; all_reduce/bilateral "
+                "modes have none (use push-sum gossip)")
         if cfg.all_reduce:
             return all_reduce(axis)
         if cfg.bilat_async:
@@ -248,6 +296,21 @@ class Trainer:
             return adpsgd(build_pairing_schedule(graph), axis)
         mixing = cfg.mixing_class() if cfg.mixing_class else None
         schedule = build_schedule(graph, mixing)
+        faults = None
+        if cfg.inject_faults:
+            # compile the fault plan against THIS schedule: masks are
+            # per-(phase, edge), so a ppi schedule change rebuilds them
+            from ..resilience import parse_fault_spec
+
+            plan = parse_fault_spec(cfg.inject_faults)
+            faults = plan.build_masks(
+                schedule,
+                gossip_every=cfg.gossip_every if cfg.push_sum else 1)
+            if not getattr(self, "_logged_faults", False):
+                # make_algorithm runs once per compiled variant; one
+                # banner per run is enough
+                self.log.warning("gossip faults: %s", plan.summary())
+                self._logged_faults = True
         staleness = (cfg.synch_freq + 1) if cfg.overlap else 1
         if cfg.synch_freq and not cfg.overlap:
             # the reference likewise only reads synch_freq under overlap
@@ -259,12 +322,14 @@ class Trainer:
                        gossip_every=cfg.gossip_every,
                        comm_dtype=self._comm_dtype(),
                        staleness=staleness,
-                       global_avg_every=cfg.global_avg_every)
+                       global_avg_every=cfg.global_avg_every,
+                       faults=faults)
         if cfg.gossip_every != 1:
             raise ValueError("gossip_every is a push-sum knob")
         return dpsgd(schedule, axis, overlap=cfg.overlap,
                      staleness=staleness,
-                     global_avg_every=cfg.global_avg_every)
+                     global_avg_every=cfg.global_avg_every,
+                     faults=faults)
 
     def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):
         """Compiled step for a peers-per-itr value; each distinct
@@ -279,7 +344,9 @@ class Trainer:
                 itr_per_epoch=itr_per_epoch, num_classes=self.cfg.num_classes,
                 local_axis=self.local_axis,
                 label_smoothing=self.cfg.label_smoothing,
-                grad_accum=self.cfg.grad_accum)
+                grad_accum=self.cfg.grad_accum,
+                health_axis=(self.gossip_axis if self.monitor is not None
+                             else None))
             if scan > 1:
                 fn = shard_scanned_train_step(
                     step, self.mesh, scan, self.gossip_axis,
@@ -505,6 +572,11 @@ class Trainer:
                         # (gap, mixing, averaging period, rationale)
                         # rides with the state it shaped
                         meta["plan"] = cfg.plan
+                    if self.monitor is not None \
+                            and self.monitor.last_payload:
+                        # the run's consensus health at save time rides
+                        # with the state it describes
+                        meta["health"] = self.monitor.last_payload
                     epoch_id = (None if cfg.overwrite_checkpoints else epoch)
                     # global-state backends (orbax on a pod) take the live
                     # sharded arrays — every process writes its own shards
@@ -636,7 +708,7 @@ class Trainer:
                 it = iter(leftovers)
             chunk = len(pending)
 
-            _, train_fn = self._train_fn(
+            alg, train_fn = self._train_fn(
                 ppi, itr_per_epoch, chunk if chunk > 1 else 1)
             if chunk > 1:
                 x = np.stack([b[0] for b in pending])
@@ -695,10 +767,60 @@ class Trainer:
             elapsed_batch = time.time() - batch_time
             record(i + 1, slices, chunk, elapsed_nn, elapsed_batch,
                    elapsed_data, timed)
+            if self.monitor is not None:
+                if timed:
+                    # per-iteration samples feed the p50/p99 straggler view
+                    for _ in range(chunk):
+                        self.monitor.record_step_time(elapsed_batch / chunk)
+                state = self._observe_health(
+                    state, alg, metrics,
+                    epoch * itr_per_epoch + i + 1, chunk)
             i += chunk
             batch_time = time.time()
 
         self._log_row(epoch, i, meters, stat_meters)
+        return state
+
+    # -- resilience --------------------------------------------------------
+
+    def _recovery_fn(self, alg):
+        """Compiled immediate-global-average for ``alg``, cached per
+        algorithm instance (the cache pins the algorithm so a dead id
+        cannot alias a new object — same idiom as averaging._FN_CACHE)."""
+        key = id(alg)
+        if key not in self._recovery_cache:
+            from ..resilience import make_recovery_fn
+
+            self._recovery_cache[key] = (
+                make_recovery_fn(alg, self.mesh, self.gossip_axis), alg)
+        return self._recovery_cache[key][0]
+
+    def _observe_health(self, state, alg, metrics, gstep0, chunk):
+        """Digest one chunk's health signals; fire recovery when the
+        policy says so.  Scanned chunks are observed per inner iteration
+        but recovered AFTER the chunk (a compiled scan cannot be
+        interrupted mid-flight) — the cooldown keeps one excursion from
+        firing once per inner step."""
+        from ..resilience.monitor import HEALTH_KEYS
+
+        if any(k not in metrics for k in HEALTH_KEYS):
+            return state  # step function built without health signals
+        arrs = {k: np.asarray(metrics[k]).reshape(self.gossip_world, chunk)
+                for k in HEALTH_KEYS}
+        for j in range(chunk):
+            # each signal is a collective over the gossip axis — every
+            # rank carries the same value; read shard 0
+            sig = {k: float(arrs[k][0, j]) for k in HEALTH_KEYS}
+            report = self.monitor.observe(gstep0 + j, sig)
+            if report.unhealthy and self.recovery_policy is not None:
+                event = self.recovery_policy.assess(report)
+                if event.action == "global-average" \
+                        and hasattr(alg, "global_average"):
+                    new_p, new_w = self._recovery_fn(alg)(
+                        state.params, state.gossip.ps_weight)
+                    state = state.replace(
+                        params=new_p,
+                        gossip=state.gossip.replace(ps_weight=new_w))
         return state
 
     def validate(self, state, algorithm, val_loader) -> float:

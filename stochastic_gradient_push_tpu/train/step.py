@@ -57,7 +57,8 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
                      itr_per_epoch: int, num_classes: int,
                      local_axis: str | None = None,
                      label_smoothing: float = 0.0,
-                     grad_accum: int = 1) -> tp.Callable:
+                     grad_accum: int = 1,
+                     health_axis: str | None = None) -> tp.Callable:
     """Returns the per-rank step ``(state, images, labels) -> (state, metrics)``.
 
     Call inside ``shard_map`` (see :func:`shard_train_step`), or directly for
@@ -80,6 +81,11 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         BatchNorm, normalization statistics are per-microbatch and the
         running-stats EMA advances once per microbatch, so dynamics differ
         slightly from the full batch (as with any microbatched BN).
+      health_axis: when set (the gossip axis), consensus health signals
+        (resilience/monitor.py) are computed after the gossip round and
+        ride the metrics pytree — ps-weight drift, push-sum mass error,
+        NaN/Inf counts, consensus-residual probe.  Each is a collective
+        over this axis, so every rank reports the same value.
     """
     if grad_accum < 1:
         raise ValueError("grad_accum must be >= 1")
@@ -164,6 +170,14 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
         if local_axis is not None:
             metrics = jax.tree.map(
                 lambda m: lax.pmean(m, local_axis), metrics)
+        if health_axis is not None:
+            # consensus health AFTER the gossip round: the signals see the
+            # state the next step will train on.  Already identical across
+            # ranks (each is a collective), so the local-axis pmean above
+            # must not re-average them — append afterwards.
+            from ..resilience.monitor import health_signals
+            metrics.update(health_signals(
+                params, grads, gstate.ps_weight, health_axis))
         new_state = state.replace(
             step=state.step + 1, params=params, batch_stats=batch_stats,
             opt_state=opt_state, gossip=gstate)

@@ -9,11 +9,12 @@ from .flatten import (
     unflatten_tensors,
 )
 from .logging import make_logger
-from .meter import Meter
+from .meter import Meter, PercentileMeter
 from .profiling import HEARTBEAT_TIMEOUT, StepWatchdog, trace
 
 __all__ = [
     "Meter",
+    "PercentileMeter",
     "make_logger",
     "flatten_tensors",
     "unflatten_tensors",

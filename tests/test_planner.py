@@ -305,3 +305,71 @@ def test_topology_name_round_trip():
         assert topology_name(cls) == name
     with pytest.raises(KeyError):
         topology_name(Plan)
+
+
+# -- satellite: mesh-distance (ring hop) comm-cost model ---------------------
+
+class _StrideRingGraph(RingGraph):
+    """A "ring" that hops 3 ranks per edge: graph-isomorphic to the
+    neighbor ring whenever gcd(3, n) == 1 (relabel ranks by r -> 3r mod
+    n), so its spectral gap and message count are IDENTICAL — only the
+    physical ICI distance of each message differs."""
+
+    STRIDE = 3
+
+    def _make_graph(self) -> None:
+        for rank in range(self.world_size):
+            self._add_peers(rank, [
+                self._rotate_forward(rank, self.STRIDE),
+                self._rotate_backward(rank, self.STRIDE)])
+
+
+class TestHopCostModel:
+    def test_ring_hop_distance_wraps(self):
+        from stochastic_gradient_push_tpu.planner.scorer import \
+            ring_hop_distance
+        assert ring_hop_distance(0, 1, 8) == 1
+        assert ring_hop_distance(0, 7, 8) == 1   # wrap-around link
+        assert ring_hop_distance(0, 4, 8) == 4
+        assert ring_hop_distance(5, 2, 8) == 3
+
+    def test_neighbor_ring_beats_same_gap_stride_ring(self):
+        """Equal gap, equal message count, 3x hop distance: the comm
+        model must prefer the topology hugging the physical mesh."""
+        from stochastic_gradient_push_tpu.planner.scorer import \
+            evaluate_candidate, hops_per_round
+        world = 8  # gcd(3, 8) == 1 -> stride ring is isomorphic
+        near = evaluate_candidate(RingGraph, world, 1)
+        far = evaluate_candidate(_StrideRingGraph, world, 1)
+        assert near is not None and far is not None
+        assert far.gap == pytest.approx(near.gap, abs=1e-9)
+        assert far.comm_cost == pytest.approx(near.comm_cost, rel=1e-9)
+        near_hops = hops_per_round(
+            build_schedule(RingGraph(world, peers_per_itr=1)))
+        far_hops = hops_per_round(
+            build_schedule(_StrideRingGraph(world, peers_per_itr=1)))
+        assert near_hops == pytest.approx(1.0)
+        assert far_hops == pytest.approx(3.0)
+        assert far.hop_cost == pytest.approx(3.0 * near.hop_cost, rel=1e-9)
+        assert near.hop_cost < far.hop_cost
+
+    def test_exponential_hops_priced_in(self):
+        """An exponential graph's long edges cost what they cost: more
+        hops per round than the ring, fewer rounds per e-fold — the
+        model weighs both instead of calling every message equal."""
+        from stochastic_gradient_push_tpu.planner.scorer import \
+            hops_per_round
+        ring_sched = build_schedule(RingGraph(64, peers_per_itr=1))
+        exp_sched = build_schedule(
+            DynamicDirectedExponentialGraph(64, peers_per_itr=1))
+        assert hops_per_round(ring_sched) == pytest.approx(1.0)
+        assert hops_per_round(exp_sched) > 5.0
+        # ...and the ranking still never prefers the non-mixing ring
+        cands = score_candidates(64, peer_counts=(1,))
+        assert cands[0].topology != "ring"
+        assert cands[0].hop_cost < float("inf")
+
+    def test_candidate_dict_carries_hop_cost(self):
+        c = score_candidates(8, peer_counts=(1,))[0]
+        d = json.loads(json.dumps(c.to_dict()))
+        assert isinstance(d["hop_cost"], float)
