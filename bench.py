@@ -46,6 +46,13 @@ seconds), BENCH_DEADLINE (overall seconds), BENCH_PROBE_TIMEOUT
 (backend-init probe seconds), BENCH_CHILD_BUDGET (child skips extras
 past this), BENCH_PHASES=0 to skip the forward-only breakdown,
 BENCH_PEAK_TFLOPS to override the peak-FLOPs table.
+
+Secondary mode — ``python bench.py --gossip-vs-ar`` (ROADMAP's
+``--global_avg_every`` wall-clock item): times gossip + periodic exact
+averaging against AllReduce-every-step on a world-8 virtual CPU mesh,
+instrumented through the telemetry span tracer, and writes a BENCH-style
+JSON artifact (default artifacts/bench_gossip_vs_ar.json; knobs
+BENCH_GVA_WORLD/BATCH/STEPS/WARMUP/GA/OUT).
 """
 
 import json
@@ -353,6 +360,147 @@ def run_measurement() -> dict:
     return out
 
 
+def run_gossip_vs_ar() -> dict:
+    """Gossip + periodic exact averaging vs AllReduce-every-step.
+
+    Closes part of the ROADMAP ``--global_avg_every`` wall-clock item:
+    the same train step is timed under (a) push-sum gossip on a ring
+    with an exact global average every ``BENCH_GVA_GA`` steps and (b)
+    exact AllReduce every step, at world ``device_count`` on the current
+    backend.  Timing runs through the telemetry span tracer (the spans
+    ARE the measurement and land in the artifact's trace), and the
+    analytic per-rank comm bytes from telemetry.comm sit next to the
+    measured milliseconds, so the modeled comm saving can be compared to
+    the observed wall-clock saving in one place.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stochastic_gradient_push_tpu.algorithms import all_reduce, sgp
+    from stochastic_gradient_push_tpu.data import synthetic_classification
+    from stochastic_gradient_push_tpu.models import TinyCNN
+    from stochastic_gradient_push_tpu.parallel import (
+        GOSSIP_AXIS, make_gossip_mesh)
+    from stochastic_gradient_push_tpu.telemetry import (
+        CommModel, SpanTracer, tree_payload_bytes)
+    from stochastic_gradient_push_tpu.topology import (
+        RingGraph, build_schedule)
+    from stochastic_gradient_push_tpu.train import (
+        LRSchedule, build_train_step, init_train_state, replicate_state,
+        sgd, shard_train_step)
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    world = jax.device_count()
+    batch = int(os.environ.get("BENCH_GVA_BATCH", "4"))
+    steps = max(1, int(os.environ.get("BENCH_GVA_STEPS", "20")))
+    warmup = max(1, int(os.environ.get("BENCH_GVA_WARMUP", "3")))
+    ga = max(1, int(os.environ.get("BENCH_GVA_GA", "8")))
+    image, classes = 16, 10
+
+    mesh = make_gossip_mesh(world)
+    model = TinyCNN(num_classes=classes)
+    tx = sgd(momentum=0.9, weight_decay=1e-4)
+    lr_sched = LRSchedule(ref_lr=0.1, batch_size=batch, world_size=world)
+    schedule = build_schedule(RingGraph(world, peers_per_itr=1))
+    tracer = SpanTracer(rank=0)
+    serialize = jax.default_backend() == "cpu"
+
+    images, labels = synthetic_classification(
+        world * batch, num_classes=classes, image_size=image, seed=0)
+    x = images.reshape(world, batch, image, image, 3)
+    y = labels.reshape(world, batch)
+
+    payload = None
+
+    def timed_ms(label, alg):
+        nonlocal payload
+        step = build_train_step(model, alg, tx, lr_sched,
+                                itr_per_epoch=100, num_classes=classes)
+        fn = shard_train_step(step, mesh)
+        st = replicate_state(
+            init_train_state(model, jax.random.PRNGKey(0),
+                             jnp.zeros((batch, image, image, 3)), tx,
+                             alg),
+            world)
+        if payload is None:
+            payload = tree_payload_bytes(st.params, world)
+        m = None
+        for _ in range(warmup):
+            st, m = fn(st, x, y)
+            if serialize:
+                jax.block_until_ready(st)
+        jax.block_until_ready(st)
+        with tracer.span(label, "bench", {"steps": steps}):
+            for _ in range(steps):
+                st, m = fn(st, x, y)
+                if serialize:
+                    jax.block_until_ready(st)
+            jax.block_until_ready(st)
+        loss = float(np.min(np.asarray(jax.device_get(m["loss"]))))
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss} in {label}")
+        return tracer.durations(label)[-1] / steps * 1e3
+
+    sgp_ms = timed_ms("sgp_ga_steps",
+                      sgp(schedule, GOSSIP_AXIS, global_avg_every=ga))
+    ar_ms = timed_ms("allreduce_steps", all_reduce(GOSSIP_AXIS))
+
+    # model the TIMED ticks: the algorithm's step counter has already
+    # advanced `warmup` ticks when the span opens, and global-average
+    # firings depend on the absolute tick
+    sgp_bytes = CommModel.from_schedule(
+        schedule, payload, global_avg_every=ga).totals(steps,
+                                                       start=warmup)
+    ar_bytes = CommModel.for_allreduce(world, payload).totals(steps)
+    out = {
+        "metric": "sgp_ga_vs_allreduce_step_ms",
+        "value": round(sgp_ms, 3),
+        "unit": "ms/step",
+        "ar_step_ms": round(ar_ms, 3),
+        "speedup_vs_ar": round(ar_ms / sgp_ms, 3) if sgp_ms else None,
+        "global_avg_every": ga,
+        "world": world,
+        "batch": batch,
+        "steps": steps,
+        "platform": jax.default_backend(),
+        "payload_bytes": payload,
+        "modeled_bytes_per_rank": {
+            "sgp_ga": sgp_bytes["gossip_wire"] + sgp_bytes["global_avg"],
+            "allreduce": ar_bytes["allreduce"],
+        },
+    }
+    out_path = os.environ.get(
+        "BENCH_GVA_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "artifacts", "bench_gossip_vs_ar.json"))
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump({"bench": out, "trace": tracer.to_chrome()}, f)
+    out["artifact"] = out_path
+    return out
+
+
+def gossip_vs_ar_main() -> int:
+    """Parent for --gossip-vs-ar: re-exec as a child on a world-8
+    virtual CPU mesh (the device-count flag must be set before jax
+    loads, hence the subprocess)."""
+    env = _child_env(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            + env.get("BENCH_GVA_WORLD", "8")).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--gossip-vs-ar-child"],
+        env=env, timeout=float(os.environ.get("BENCH_TIMEOUT", "600")))
+    return proc.returncode
+
+
 def _parse_last_json(text: str) -> dict | None:
     for line in reversed((text or "").strip().splitlines()):
         line = line.strip()
@@ -650,5 +798,9 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         print(json.dumps(run_measurement()), flush=True)
+    elif "--gossip-vs-ar-child" in sys.argv:
+        print(json.dumps(run_gossip_vs_ar()), flush=True)
+    elif "--gossip-vs-ar" in sys.argv:
+        sys.exit(gossip_vs_ar_main())
     else:
         main()

@@ -25,6 +25,10 @@ echo "== chaos self-check (resilience: faults -> monitor -> recovery) =="
 python scripts/chaos.py --selftest
 
 echo
+echo "== obsreport self-check (telemetry: tracer -> events -> report) =="
+python scripts/obsreport.py --selftest
+
+echo
 echo "== tier-1 tests (CPU, not slow) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

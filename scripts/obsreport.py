@@ -1,0 +1,416 @@
+#!/usr/bin/env python
+"""obsreport — one run report from a telemetry directory.
+
+Ingests the artifacts a ``--trace_dir`` run leaves behind —
+``events.jsonl`` (typed plan/health/recovery/comm/step_stats events,
+telemetry/registry.py schema), ``trace.json`` (Chrome-trace host spans,
+telemetry/tracer.py), and any checkpoint metadata in the same directory
+— and emits a single run report: step-time p50/p99, per-phase wall-clock
+totals, measured gossip-vs-compute step overhead, the health excursion
+timeline, recovery/stall counts, and comm bytes by category next to the
+analytic model that produced them.
+
+Usage:
+    python scripts/obsreport.py RUN_DIR            # human-readable report
+    python scripts/obsreport.py RUN_DIR --json     # machine-readable
+    python scripts/obsreport.py --selftest         # CI gate
+
+Exit codes: 0 clean, 1 selftest/report failure, 2 unusable run dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# report building is pure host work; never let a platform plugin pull in
+# an accelerator runtime just to read JSON (same pattern as plan.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from stochastic_gradient_push_tpu.telemetry import (  # noqa: E402
+    EVENTS_FILE,
+    SCHEMA_VERSION,
+    TRACE_FILE,
+)
+from stochastic_gradient_push_tpu.utils.meter import (  # noqa: E402
+    PercentileMeter,
+)
+
+# -- loading ---------------------------------------------------------------
+
+
+def _event_files(run_dir: str) -> list[str]:
+    """events.jsonl plus any per-process events_rN.jsonl siblings (a
+    multi-process run writes one file per rank to avoid interleaving)."""
+    import glob
+
+    base, ext = os.path.splitext(EVENTS_FILE)
+    return sorted(
+        glob.glob(os.path.join(run_dir, EVENTS_FILE))
+        + glob.glob(os.path.join(run_dir, f"{base}_r*{ext}")))
+
+
+def load_events(run_dir: str) -> list[dict]:
+    events = []
+    for path in _event_files(run_dir):
+        with open(path) as f:
+            for n, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{path}:{n}: unparseable event: {e}")
+    return events
+
+
+def check_events(events: list[dict]) -> list[str]:
+    """Schema check; returns a list of problems (empty = clean)."""
+    problems = []
+    for n, ev in enumerate(events, start=1):
+        for field in ("v", "kind", "t", "rank", "severity", "data"):
+            if field not in ev:
+                problems.append(f"event {n}: missing field {field!r}")
+        if ev.get("v") not in (None, SCHEMA_VERSION):
+            problems.append(
+                f"event {n}: schema version {ev['v']} (reader speaks "
+                f"{SCHEMA_VERSION})")
+        if "data" in ev and not isinstance(ev["data"], dict):
+            problems.append(f"event {n}: data is not an object")
+    return problems
+
+
+def load_trace(run_dir: str) -> list[dict]:
+    """Trace events, or [] when trace.json is absent — a killed run
+    leaves a flushed events.jsonl but no trace (trace.json is written
+    at finish()), and the report must still work on exactly that."""
+    path = os.path.join(run_dir, TRACE_FILE)
+    if not os.path.isfile(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome-trace object "
+                         "(no traceEvents)")
+    return doc["traceEvents"]
+
+
+def check_trace(trace_events: list[dict]) -> list[str]:
+    """Chrome-trace validity: required fields per event, monotone ts."""
+    problems = []
+    last_ts = -1.0
+    for n, ev in enumerate(trace_events, start=1):
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "i", "I"):
+            problems.append(f"trace event {n}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            continue
+        for field in ("name", "ts", "pid", "tid"):
+            if field not in ev:
+                problems.append(f"trace event {n}: missing {field!r}")
+        if ph == "X" and "dur" not in ev:
+            problems.append(f"trace event {n}: X event without dur")
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            if ts < last_ts:
+                problems.append(
+                    f"trace event {n}: ts {ts} < previous {last_ts} "
+                    "(not monotone)")
+            last_ts = ts
+    return problems
+
+
+def load_ckpt_meta(run_dir: str) -> dict | None:
+    """Metadata from a checkpoint saved into the run dir, if any (the
+    trainer stamps plan + last health payload into it)."""
+    try:
+        from flax import serialization
+    except ImportError:
+        return None
+    names = sorted(f for f in os.listdir(run_dir) if f.endswith(".ckpt"))
+    for name in names:
+        try:
+            with open(os.path.join(run_dir, name), "rb") as f:
+                raw = serialization.msgpack_restore(f.read())
+        except (OSError, ValueError):
+            continue
+        if isinstance(raw, dict) and "meta" in raw:
+            meta = dict(raw["meta"])
+            meta["_file"] = name
+            return meta
+    return None
+
+
+# -- report ----------------------------------------------------------------
+
+
+def build_report(run_dir: str) -> dict:
+    events = load_events(run_dir)
+    trace = load_trace(run_dir)
+    trace_present = os.path.isfile(os.path.join(run_dir, TRACE_FILE))
+    problems = check_events(events) + check_trace(trace)
+
+    by_kind: dict[str, list[dict]] = {}
+    for ev in events:
+        by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+
+    # step-time percentiles from timed train_step spans (warmup/compile
+    # spans carry timed=False and are excluded)
+    meter = PercentileMeter(maxlen=65536, ptag="step")
+    gossip_durs, plain_durs = [], []
+    phase_totals: dict[str, float] = {}
+    for ev in trace:
+        if ev.get("ph") != "X":
+            continue
+        dur_s = float(ev.get("dur", 0.0)) / 1e6
+        phase_totals[ev.get("cat", "?")] = (
+            phase_totals.get(ev.get("cat", "?"), 0.0) + dur_s)
+        if ev.get("name") == "train_step":
+            args = ev.get("args", {})
+            steps = max(1, int(args.get("steps", 1)))
+            per_step = dur_s / steps
+            if args.get("timed", True):
+                meter.update(per_step)
+                if "gossip" in args:
+                    (gossip_durs if args["gossip"] else
+                     plain_durs).append(per_step)
+
+    # measured gossip overhead: only measurable when the run thinned
+    # communication (gossip_every > 1) so both step classes exist
+    overhead = None
+    if gossip_durs and plain_durs:
+        overhead = (sum(gossip_durs) / len(gossip_durs)
+                    - sum(plain_durs) / len(plain_durs))
+
+    health = by_kind.get("health", [])
+    excursions = [
+        {"step": ev.get("step"),
+         "reasons": ev["data"].get("reasons", [])}
+        for ev in health if ev.get("severity") in ("warning", "error")]
+    recoveries = by_kind.get("recovery", [])
+    heartbeats = by_kind.get("heartbeat", [])
+    comm = by_kind.get("comm", [])
+    comm_final = comm[-1]["data"] if comm else None
+    run_meta = by_kind.get("run_meta", [])
+    plan = by_kind.get("plan", [])
+
+    report = {
+        "run_dir": run_dir,
+        "trace_present": trace_present,
+        "schema_problems": problems,
+        "events": {k: len(v) for k, v in sorted(by_kind.items())},
+        "run_meta": run_meta[0]["data"] if run_meta else None,
+        "plan": plan[0]["data"] if plan else None,
+        "step_time": {
+            "timed_steps": meter.count,
+            "p50_s": round(meter.p50, 6),
+            "p99_s": round(meter.p99, 6),
+        },
+        "phase_totals_s": {k: round(v, 6)
+                           for k, v in sorted(phase_totals.items())},
+        "gossip_step_overhead_s": (round(overhead, 6)
+                                   if overhead is not None else None),
+        "health": {
+            "reports": len(health),
+            "excursions": len(excursions),
+            "timeline": excursions[:50],
+        },
+        "recoveries": {
+            "count": len(recoveries),
+            "actions": sorted({ev["data"].get("action", "?")
+                               for ev in recoveries}),
+        },
+        "heartbeat_stalls": len(heartbeats),
+        "comm": comm_final,
+        "ckpt_meta": load_ckpt_meta(run_dir),
+    }
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [f"== obsreport: {report['run_dir']} =="]
+    if not report.get("trace_present", True):
+        lines.append("!! trace.json missing (run killed before "
+                     "finish()?) — span metrics unavailable, events "
+                     "only")
+    if report["schema_problems"]:
+        lines.append(f"!! {len(report['schema_problems'])} schema "
+                     "problem(s):")
+        lines += [f"   - {p}" for p in report["schema_problems"][:10]]
+    lines.append("events: " + ", ".join(
+        f"{k}={v}" for k, v in report["events"].items()))
+    rm = report["run_meta"]
+    if rm:
+        lines.append(
+            f"run: world {rm.get('world')} algorithm "
+            f"{rm.get('algorithm')} gossip_every "
+            f"{rm.get('gossip_every')} global_avg_every "
+            f"{rm.get('global_avg_every', 0)}")
+    st = report["step_time"]
+    lines.append(f"step time: p50 {st['p50_s']*1e3:.2f} ms  "
+                 f"p99 {st['p99_s']*1e3:.2f} ms  "
+                 f"({st['timed_steps']} timed steps)")
+    if report["gossip_step_overhead_s"] is not None:
+        lines.append("gossip-vs-compute: gossip rounds add "
+                     f"{report['gossip_step_overhead_s']*1e3:.2f} ms "
+                     "per gossiping step (vs thinned steps)")
+    if report["phase_totals_s"]:
+        lines.append("host wall-clock by phase: " + ", ".join(
+            f"{k} {v:.3f}s" for k, v in
+            report["phase_totals_s"].items()))
+    h = report["health"]
+    lines.append(f"health: {h['reports']} report(s), "
+                 f"{h['excursions']} excursion(s)")
+    for e in h["timeline"][:5]:
+        lines.append(f"   step {e['step']}: {', '.join(e['reasons'])}")
+    lines.append(f"recoveries: {report['recoveries']['count']} "
+                 f"{report['recoveries']['actions']}")
+    lines.append(f"heartbeat stalls: {report['heartbeat_stalls']}")
+    c = report["comm"]
+    if c:
+        by = c.get("bytes", {})
+        lines.append(
+            f"comm (per-rank bytes, {c.get('steps')} steps, "
+            f"{c.get('gossip_rounds')} gossip rounds, "
+            f"{c.get('global_avgs')} scheduled avgs, "
+            f"{c.get('recoveries')} recovery avgs):")
+        for k, v in sorted(by.items()):
+            if v:
+                lines.append(f"   {k:>18}: {v:,}")
+    meta = report["ckpt_meta"]
+    if meta:
+        keys = sorted(k for k in meta if not k.startswith("_"))
+        lines.append(f"checkpoint meta ({meta.get('_file')}): "
+                     + ", ".join(keys))
+    return "\n".join(lines)
+
+
+# -- selftest --------------------------------------------------------------
+
+
+def selftest() -> int:
+    """Synthesize a run dir through the real telemetry APIs, then hold
+    the report to the analytic comm model — the CI gate check.sh runs."""
+    import tempfile
+
+    from stochastic_gradient_push_tpu.telemetry import (
+        CommModel, allreduce_bytes, make_run_telemetry)
+    from stochastic_gradient_push_tpu.topology import (
+        RingGraph, build_schedule)
+
+    with tempfile.TemporaryDirectory() as d:
+        rt = make_run_telemetry(d, rank=0, metrics_every=4)
+        schedule = build_schedule(RingGraph(8, peers_per_itr=1))
+        payload = 10_000
+        model = CommModel.from_schedule(schedule, payload,
+                                        global_avg_every=8)
+        acc = rt.attach_comm(model)
+        rt.registry.emit("run_meta", {
+            "world": 8, "algorithm": "sgp", "gossip_every": 1,
+            "global_avg_every": 8, "comm_model": model.to_dict()})
+        rt.registry.emit("plan", {"topology": "ring", "world": 8})
+        t0 = rt.tracer.now()
+        num_steps = 16
+        for t in range(num_steps):
+            acc.on_step(t)
+            start = t0 + t * 0.01
+            rt.tracer.complete("data_fetch", "data", start, 0.002)
+            rt.tracer.complete(
+                "train_step", "step", start + 0.002, 0.008,
+                {"steps": 1, "timed": t >= 2,
+                 "gossip": int(model.gossip_fires(t)),
+                 "global_avg": int(model.global_avg_fires(t))})
+        rt.registry.emit("health", {
+            "step": 9, "consensus_residual": 0.5,
+            "reasons": ["residual-above-floor"]}, step=9,
+            severity="warning")
+        rt.registry.emit("recovery", {
+            "step": 9, "action": "global-average",
+            "reasons": ["residual-above-floor"]}, step=9,
+            severity="warning")
+        with rt.span("recovery_global_average", "recovery"):
+            acc.on_recovery()
+        rt.registry.emit("heartbeat", {"elapsed_s": 301.0,
+                                       "timeout_s": 300}, severity="error")
+        with rt.span("checkpoint_save", "checkpoint"):
+            pass
+        rt.finish(step=num_steps - 1)
+
+        report = build_report(d)
+        print(render(report))
+
+        ok = True
+
+        def expect(cond, what):
+            nonlocal ok
+            if not cond:
+                ok = False
+                print(f"FAIL: {what}", flush=True)
+
+        expect(report["schema_problems"] == [],
+               f"schema problems: {report['schema_problems']}")
+        expect(report["step_time"]["timed_steps"] == num_steps - 2,
+               "timed step count")
+        expect(report["step_time"]["p50_s"] > 0, "p50 > 0")
+        expect(report["step_time"]["p99_s"] >=
+               report["step_time"]["p50_s"], "p99 >= p50")
+        expect(report["health"]["excursions"] == 1, "one excursion")
+        expect(report["recoveries"]["count"] == 1, "one recovery")
+        expect(report["heartbeat_stalls"] == 1, "one stall")
+        # the analytic gate: reported bytes equal the model's expectation
+        want = model.totals(num_steps)
+        want["recovery"] = allreduce_bytes(payload, 8)
+        got = report["comm"]["bytes"]
+        expect(got == want, f"comm bytes {got} != analytic {want}")
+        expect(report["comm"]["gossip_rounds"] == num_steps,
+               "gossip round count")
+        expect(report["comm"]["global_avgs"] == 2, "scheduled avgs")
+        # phase tracks present in the trace
+        for phase in ("data", "step", "recovery", "checkpoint"):
+            expect(phase in report["phase_totals_s"],
+                   f"phase {phase} missing from trace")
+
+        print("obsreport selftest:", "OK" if ok else "FAILED",
+              flush=True)
+        return 0 if ok else 1
+
+
+# -- entry -----------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_dir", nargs="?", help="telemetry directory "
+                   "(contains events.jsonl + trace.json)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object")
+    p.add_argument("--selftest", action="store_true",
+                   help="synthesize a run and verify the report "
+                        "pipeline (CI gate)")
+    args = p.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.run_dir:
+        p.error("run_dir required (or --selftest)")
+    if not _event_files(args.run_dir):
+        print(f"error: no {EVENTS_FILE} under {args.run_dir} — was the "
+              "run started with --trace_dir?", file=sys.stderr)
+        return 2
+    report = build_report(args.run_dir)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render(report))
+    return 1 if report["schema_problems"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

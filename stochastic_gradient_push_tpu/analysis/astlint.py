@@ -60,6 +60,14 @@ _HOST_EFFECTS = {
 # jax.random callables that *refresh* rather than consume a key
 _KEY_REFRESHERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data"}
 
+# telemetry emission surface (telemetry/ tracer + registry): attribute
+# calls banned in traced code (SGPL009) — a span or event emitted inside
+# a jitted function fires once at trace time and records tracing, not
+# execution.  Attribute-name matching keeps the rule alias-proof (the
+# objects arrive as arguments, not imports).
+_TELEMETRY_ATTRS = {"span", "instant", "trace_complete", "emit",
+                    "emit_comm"}
+
 _SUPPRESS_RE = re.compile(r"#\s*sgplint:\s*disable=([A-Za-z0-9_,\s]+|all)")
 
 # paths (relative, substring match on separators) where SGPL007 does not
@@ -351,7 +359,18 @@ class _Linter(ast.NodeVisitor):
             self._check_axis_arg(node, name)
         if self.in_traced():
             self._check_host_effect(node, name)
+            self._check_telemetry_emission(node)
         self.generic_visit(node)
+
+    # -- SGPL009: telemetry emission in traced code ------------------------
+
+    def _check_telemetry_emission(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _TELEMETRY_ATTRS:
+            self.add(node, "SGPL009",
+                     f".{node.func.attr}() telemetry emission inside "
+                     "traced code runs at trace time only — emit from "
+                     "the host loop around the compiled call")
 
     def _check_axis_arg(self, node: ast.Call, fn: str) -> None:
         short = fn.rsplit(".", 1)[1]

@@ -42,6 +42,13 @@ RULES: dict[str, tuple[str, str]] = {
     "SGPL008": (
         "global-state mutation inside jit/shard_map-traced code",
         "return the new value instead; traced functions must be pure"),
+    "SGPL009": (
+        "telemetry span/event emission inside jit/shard_map-traced code "
+        "(runs once at trace time, then never again — and a recording "
+        "span would time tracing, not execution)",
+        "emit spans/events from the host loop around the compiled call; "
+        "in-graph signals must ride the metrics pytree instead "
+        "(resilience/monitor.py health_signals is the pattern)"),
     "SGPV101": (
         "gossip phase sub-round is not a permutation (ppermute would drop "
         "or duplicate messages)",

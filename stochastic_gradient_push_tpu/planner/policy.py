@@ -339,7 +339,7 @@ def resolve_topology(world: int, *, ppi: int = 1,
                      algorithm: str = "sgp",
                      self_weighted: bool | float = False,
                      global_avg_every: int | None = None,
-                     log=None) -> Plan:
+                     log=None, registry=None) -> Plan:
     """Run-layer entry point: resolve ``--topology``/``--graph_type`` into
     a :class:`Plan`, log it, and emit any warnings.
 
@@ -353,6 +353,9 @@ def resolve_topology(world: int, *, ppi: int = 1,
         k = every-k averaging regardless of the gap).
       log: optional logger; the plan is logged as one JSON line and each
         warning loudly via ``log.warning``.
+      registry: optional telemetry registry; when set, the plan publishes
+        as a typed ``plan`` event (the registry's compat sink renders the
+        legacy ``gossip plan:`` line) and ``log`` carries only warnings.
     """
     if topology == "auto":
         plan = plan_for(world, ppi=ppi, algorithm=algorithm,
@@ -367,9 +370,13 @@ def resolve_topology(world: int, *, ppi: int = 1,
         plan = check_topology(world, cls, ppi=ppi, algorithm=algorithm,
                               floor=floor, self_weighted=self_weighted,
                               global_avg_every=global_avg_every)
-    if log is not None:
+    if registry is not None:
+        # info like the legacy line (plan *warnings* go via log below)
+        registry.emit("plan", plan.to_dict(), severity="info")
+    elif log is not None:
         log.info("gossip plan: %s", json.dumps(plan.to_dict(),
                                                sort_keys=True))
+    if log is not None:
         for msg in plan.warnings:
             log.warning(msg)
     return plan

@@ -147,7 +147,7 @@ class HealthMonitor:
                  residual_floor: float = 0.01,
                  mass_tol: float = DEFAULT_MASS_TOL,
                  ps_weight_floor: float = DEFAULT_PS_WEIGHT_FLOOR,
-                 log=None, step_window: int = 1024):
+                 log=None, step_window: int = 1024, registry=None):
         if health_every < 1:
             raise ValueError("health_every must be >= 1")
         self.health_every = health_every
@@ -155,6 +155,11 @@ class HealthMonitor:
         self.mass_tol = mass_tol
         self.ps_weight_floor = ps_weight_floor
         self.log = log
+        # telemetry registry (telemetry.TelemetryRegistry): when set, the
+        # monitor publishes typed `health` events and the registry's
+        # LoggerCompatSink owns the legacy `gossip health:` line; when
+        # None the pre-telemetry direct-logging path is unchanged
+        self.registry = registry
         self.step_time = PercentileMeter(maxlen=step_window, ptag="Step")
         self.last_payload: dict | None = None
         self.reports: int = 0
@@ -200,12 +205,20 @@ class HealthMonitor:
         report = HealthReport(step=int(step), payload=payload,
                               reasons=reasons)
         due = step % self.health_every == 0
-        if self.log is not None and (due or reasons):
-            line = "gossip health: " + json.dumps(payload, sort_keys=True)
-            if reasons:
-                self.log.warning(line)
-            else:
-                self.log.info(line)
+        if due or reasons:
+            if self.registry is not None:
+                # typed event; the compat sink reproduces the exact
+                # legacy line from the same payload
+                self.registry.emit(
+                    "health", payload, step=int(step),
+                    severity="warning" if reasons else "info")
+            elif self.log is not None:
+                line = "gossip health: " + json.dumps(payload,
+                                                      sort_keys=True)
+                if reasons:
+                    self.log.warning(line)
+                else:
+                    self.log.info(line)
         if due or reasons:
             self.reports += 1
         if reasons:

@@ -108,7 +108,7 @@ class RecoveryPolicy:
                  topology: str | None = None,
                  residual_floor: float = 0.01,
                  cooldown_steps: int = 10,
-                 max_recoveries: int = 0, log=None):
+                 max_recoveries: int = 0, log=None, registry=None):
         self.world = world
         self.ppi = ppi
         self.algorithm = algorithm
@@ -117,6 +117,10 @@ class RecoveryPolicy:
         self.cooldown_steps = max(0, cooldown_steps)
         self.max_recoveries = max_recoveries
         self.log = log
+        # telemetry registry: when set, decisions publish as typed
+        # `recovery` events (the compat sink keeps the legacy line);
+        # when None the direct-logging path below is unchanged
+        self.registry = registry
         self.recoveries = 0
         self.last_fired_step: int | None = None
         self.events: list[RecoveryEvent] = []
@@ -166,7 +170,10 @@ class RecoveryPolicy:
                                   tuple(report.reasons), None)
         if event.action != "none":
             self.events.append(event)
-            if self.log is not None:
+            if self.registry is not None:
+                self.registry.emit("recovery", event.to_dict(),
+                                   step=report.step, severity="warning")
+            elif self.log is not None:
                 self.log.warning("gossip recovery: "
                                  + json.dumps(event.to_dict(),
                                               sort_keys=True))

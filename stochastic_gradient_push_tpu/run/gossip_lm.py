@@ -183,6 +183,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "run then continues untraced with a warning and "
                         "the supported attribution is the fwd/fwdbwd "
                         "probes (docs/MFU_ANALYSIS.md)")
+    p.add_argument("--trace_dir", default=None, type=str,
+                   help="run telemetry directory (telemetry/): "
+                        "trace.json host spans + events.jsonl typed "
+                        "plan/health/recovery/comm events; analyze with "
+                        "scripts/obsreport.py.  Unset = telemetry off")
+    p.add_argument("--metrics_every", default=0, type=int,
+                   help="emit a step_stats + comm telemetry event every "
+                        "k steps (rides the --print_freq metrics fetch "
+                        "cadence; 0 = only the final comm snapshot); "
+                        "requires --trace_dir")
     # multi-host (same surface as gossip_sgd)
     p.add_argument("--multihost", default="auto",
                    choices=["auto", "True", "False"],
@@ -311,6 +321,11 @@ def main(argv=None):
         fault_plan = parse_fault_spec(args.inject_faults)
     else:
         fault_plan = None
+    if args.metrics_every < 0:
+        raise SystemExit("--metrics_every must be >= 0")
+    if args.metrics_every and not args.trace_dir:
+        raise SystemExit("--metrics_every needs --trace_dir (telemetry "
+                         "events have nowhere to go without it)")
     if args.health_every < 0:
         raise SystemExit("--health_every must be >= 0")
     if args.health_every:
@@ -325,6 +340,13 @@ def main(argv=None):
                 f"--health_every {args.health_every} must be a multiple "
                 f"of --print_freq {args.print_freq} (health signals ride "
                 "the metrics fetch cadence)")
+
+    # run telemetry BEFORE planning so the plan event and the loop share
+    # one events.jsonl (the zero-overhead null bundle without --trace_dir)
+    from ..telemetry import make_run_telemetry
+
+    rt = make_run_telemetry(args.trace_dir, rank=proc_index, log=log,
+                            metrics_every=args.metrics_every)
 
     # launch-time topology policy BEFORE any mesh/device work (planning is
     # pure numpy, and a below-floor warning must reach the user even when
@@ -342,7 +364,7 @@ def main(argv=None):
             self_weighted=(True if args.mixing_alpha == "auto"
                            else (args.mixing_alpha or False)),
             global_avg_every=args.global_avg_every,  # None = policy
-            log=log)
+            log=log, registry=rt.registry)
     elif args.topology is not None and (sb(args.all_reduce)
                                         or sb(args.bilat)):
         raise SystemExit("--topology selects a push-sum/D-PSGD gossip "
@@ -631,6 +653,39 @@ def main(argv=None):
                        jax.tree.map(lambda a: a[0], state.params)))
     log.info(f"mesh {mesh}; {n_params/1e6:.2f}M params; attn={attn}")
 
+    # comm-volume accounting (telemetry/): flat dp / dp×sp meshes only —
+    # ep/tp/pp shard params on non-leading dims, so the per-rank payload
+    # arithmetic would be wrong there (same fence as --health_every)
+    if rt.enabled and pp == 1 and ep == 1 and tp == 1:
+        from ..telemetry import CommModel, tree_payload_bytes
+
+        exact = tree_payload_bytes(state.params, dp)
+        if sb(args.all_reduce):
+            comm_model = CommModel.for_allreduce(dp, exact)
+        elif sb(args.bilat):
+            comm_model = CommModel.for_bilat(dp, exact)
+        else:
+            wire = (tree_payload_bytes(state.params, dp, itemsize=2)
+                    if args.gossip_comm_dtype == "bf16" else exact)
+            comm_model = CommModel.from_schedule(
+                alg.schedule, wire, exact_bytes=exact,
+                gossip_every=alg.gossip_every,
+                global_avg_every=alg.global_avg_every,
+                faults=alg.faults, ps_weight=sb(args.push_sum))
+        rt.attach_comm(comm_model)
+    if rt.enabled:
+        rt.registry.emit("run_meta", {
+            "world": world, "dp": dp, "sp": sp, "tp": tp, "ep": ep,
+            "pp": pp,
+            "algorithm": ("all_reduce" if sb(args.all_reduce) else
+                          "adpsgd" if sb(args.bilat) else
+                          "sgp" if sb(args.push_sum) else "dpsgd"),
+            "gossip_every": args.gossip_every,
+            "batch_size": args.batch_size,
+            "num_steps": args.num_steps,
+            "comm_model": (rt.comm.model.to_dict()
+                           if rt.comm is not None else None)})
+
     # checkpoint/resume: state and step counter in one atomic msgpack
     # payload (same manager as the image harness); restored leaves are
     # device_put back into the live state's shardings.  On a pod each
@@ -695,6 +750,7 @@ def main(argv=None):
     if start_step >= args.num_steps:
         log.info(f"nothing to do: resumed at step {start_step} >= "
                  f"num_steps {args.num_steps}")
+        rt.finish(step=start_step)
         return {"final_loss": None, "avg_loss": None,
                 "tokens_per_sec": 0.0, "already_complete": True}
 
@@ -708,13 +764,15 @@ def main(argv=None):
             # the run's consensus health at save time rides with the
             # state it describes (resilience/monitor.py)
             meta["health"] = monitor.last_payload
-        if use_orbax:
-            # orbax steps are keyed by id: pass the step explicitly (the
-            # live sharded state on pods, host conversion single-process)
-            ckpt.save(st, meta, epoch_id=step)
-        else:
-            ckpt.save(host_local_slice(st) if proc_count > 1 else st,
-                      meta)
+        with rt.span("checkpoint_save", "checkpoint"):
+            if use_orbax:
+                # orbax steps are keyed by id: pass the step explicitly
+                # (the live sharded state on pods, host conversion
+                # single-process)
+                ckpt.save(st, meta, epoch_id=step)
+            else:
+                ckpt.save(host_local_slice(st) if proc_count > 1 else st,
+                          meta)
 
     if args.corpus_file:
         from ..data.lm import load_corpus
@@ -781,7 +839,7 @@ def main(argv=None):
 
     from ..utils.profiling import StepWatchdog
     watchdog = (StepWatchdog(timeout=args.heartbeat_timeout,
-                             rank=proc_index)
+                             rank=proc_index, registry=rt.registry)
                 if args.heartbeat_timeout > 0 else None)
     prints_done = 0
 
@@ -795,7 +853,7 @@ def main(argv=None):
 
         monitor = HealthMonitor(health_every=args.health_every,
                                 residual_floor=args.residual_floor,
-                                log=log)
+                                log=log, registry=rt.registry)
         # (fetch time, steps_done, val_time) at the previous metrics
         # fetch — step-time samples are per-WINDOW deltas, so a straggler
         # phase moves p99 instead of dissolving into the lifetime mean
@@ -807,7 +865,8 @@ def main(argv=None):
                 algorithm="sgp" if sb(args.push_sum) else "dpsgd",
                 topology=plan.topology if plan is not None else None,
                 residual_floor=args.residual_floor,
-                cooldown_steps=args.health_every, log=log)
+                cooldown_steps=args.health_every, log=log,
+                registry=rt.registry)
             recovery = make_recovery_fn(alg, mesh)
 
     loss_meter = Meter(ptag="Loss")
@@ -876,124 +935,155 @@ def main(argv=None):
         nonlocal val_time
         t_val = time.time()
         vals = []
-        for vt, vy in lm_batches(val_corpus, dp * ep, sp,
-                                 args.batch_size, args.seq_len, seed=1):
-            m = eval_fn(st, globalize(shape_batch(vt)),
-                        globalize(shape_batch(vy)))
-            if serialize:
-                jax.block_until_ready(m)
-            vals.append(float(np.mean(host_metrics(m)["loss"])))
-            if len(vals) >= args.val_batches:
-                break
+        with rt.span("validate", "eval"):
+            for vt, vy in lm_batches(val_corpus, dp * ep, sp,
+                                     args.batch_size, args.seq_len,
+                                     seed=1):
+                m = eval_fn(st, globalize(shape_batch(vt)),
+                            globalize(shape_batch(vy)))
+                if serialize:
+                    jax.block_until_ready(m)
+                vals.append(float(np.mean(host_metrics(m)["loss"])))
+                if len(vals) >= args.val_batches:
+                    break
         vl = float(np.mean(vals))
         val_time += time.time() - t_val
         return vl, float(np.exp(vl))
 
     last_val = None
+    last_stats_emit = start_step
     prof_started = prof_stopped = False
-    while steps_done < args.num_steps:
-        for tokens, targets in lm_batches(corpus, dp * ep, sp,
-                                          args.batch_size, args.seq_len,
-                                          seed=args.seed + epoch):
-            if skip_batches:
-                skip_batches -= 1
-                continue
-            state, metrics = train_fn(state, globalize(shape_batch(tokens)),
-                                      globalize(shape_batch(targets)))
-            if serialize:
-                jax.block_until_ready(state)
-            steps_done += 1
-            if args.profile_dir and not prof_stopped:
-                # bounded trace window: steps 2-4 (step 1 pays the
-                # compile).  Guarded: over a tunneled backend the
-                # profiler RPC hangs, so a timed-out start/stop degrades
-                # to probe-only attribution instead of stalling the run
-                # (utils/profiling.py tunnel caveat)
-                from ..utils.profiling import (start_trace_guarded,
-                                               stop_trace_guarded)
-
-                if not prof_started and steps_done == start_step + 1:
-                    if start_trace_guarded(args.profile_dir):
-                        prof_started = True
-                    else:
-                        prof_stopped = True  # don't retry a hung profiler
-                elif prof_started and steps_done >= start_step + 4:
+    try:
+        while steps_done < args.num_steps:
+            for tokens, targets in lm_batches(corpus, dp * ep, sp,
+                                              args.batch_size, args.seq_len,
+                                              seed=args.seed + epoch):
+                if skip_batches:
+                    skip_batches -= 1
+                    continue
+                state, metrics = train_fn(state, globalize(shape_batch(tokens)),
+                                          globalize(shape_batch(targets)))
+                if serialize:
                     jax.block_until_ready(state)
-                    stop_trace_guarded()
-                    prof_stopped = True
-            if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
-                guard = (watchdog.step()
-                         if watchdog is not None and prints_done >= 1
-                         else contextlib.nullcontext())
-                with guard:
-                    mh = host_metrics(metrics)
-                prints_done += 1
-                if monitor is not None:
-                    from ..resilience.monitor import HEALTH_KEYS
+                steps_done += 1
+                if rt.comm is not None:
+                    # step tick is 0-based (matches the algorithm's phase
+                    # counter); host integer math, dispatch stays async
+                    rt.comm.on_step(steps_done - 1)
+                if args.profile_dir and not prof_stopped:
+                    # bounded trace window: steps 2-4 (step 1 pays the
+                    # compile).  Guarded: over a tunneled backend the
+                    # profiler RPC hangs, so a timed-out start/stop degrades
+                    # to probe-only attribution instead of stalling the run
+                    # (utils/profiling.py tunnel caveat)
+                    from ..utils.profiling import (start_trace_guarded,
+                                                   stop_trace_guarded)
 
-                    # one sample per fetch window: the window's own
-                    # average step time (validation time excluded), NOT
-                    # the cumulative run average.  The first window is
-                    # skipped — it carries the XLA compile.
-                    now = time.time()
-                    if health_window_start is not None:
-                        t_prev, s_prev, v_prev = health_window_start
-                        steps_in_window = steps_done - s_prev
-                        if steps_in_window > 0:
-                            elapsed = (now - t_prev) - (val_time - v_prev)
-                            monitor.record_step_time(
-                                max(0.0, elapsed) / steps_in_window)
-                    health_window_start = (now, steps_done, val_time)
-                    sig = {k: float(np.asarray(mh[k]).ravel()[0])
-                           for k in HEALTH_KEYS}
-                    report = monitor.observe(steps_done, sig)
-                    if report.unhealthy and policy is not None:
-                        event = policy.assess(report)
-                        if event.action == "global-average":
-                            new_p, new_w = recovery(
-                                state.params, state.gossip.ps_weight)
-                            state = state.replace(
-                                params=new_p,
-                                gossip=state.gossip.replace(
-                                    ps_weight=new_w))
-                loss = float(np.mean(mh["loss"]))
-                loss_meter.update(loss)
-                tps = (tokens_per_step * (steps_done - start_step)
-                       / (time.time() - t0 - val_time))
-                row = (f"{steps_done},{loss:.4f},"
-                       f"{float(np.mean(mh['ppl'])):.2f},"
-                       f"{float(np.mean(mh['lr'])):.5f},"
-                       f"{tps:.0f},"
-                       f"{float(np.mean(mh['grad_norm'])):.4f}")
-                if moe_on:
-                    row += (",%.4f" % float(np.mean(mh['moe_dropped'])))
-                if val_on:
-                    val_due = ((args.val_every and steps_done
-                                % args.val_every == 0)
-                               or steps_done >= args.num_steps)
-                    if val_due:
-                        vl, vppl = run_validation(state)
-                        last_val = vl
-                        row += f",{vl:.4f},{vppl:.2f}"
-                    else:
-                        row += ",,"
-                with open(out_fname, "a") as f:
-                    print(row, file=f)
-            if args.ckpt_every and steps_done % args.ckpt_every == 0:
-                save_ckpt(state, steps_done)
-                last_saved = steps_done
-            if steps_done >= args.num_steps:
-                break
-        epoch += 1
-    if last_saved != steps_done:
-        save_ckpt(state, steps_done)
-    if use_orbax:
-        ckpt.wait()  # async saves must land before exit
-        ckpt.close()
-    if prof_started and not prof_stopped:
-        from ..utils.profiling import stop_trace_guarded
+                    if not prof_started and steps_done == start_step + 1:
+                        if start_trace_guarded(args.profile_dir):
+                            prof_started = True
+                        else:
+                            prof_stopped = True  # don't retry a hung profiler
+                    elif prof_started and steps_done >= start_step + 4:
+                        jax.block_until_ready(state)
+                        stop_trace_guarded()
+                        prof_stopped = True
+                if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
+                    guard = (watchdog.step()
+                             if watchdog is not None and prints_done >= 1
+                             else contextlib.nullcontext())
+                    with guard, rt.span("metrics_fetch", "step",
+                                        {"step": steps_done}
+                                        if rt.enabled else None):
+                        mh = host_metrics(metrics)
+                    prints_done += 1
+                    if monitor is not None:
+                        from ..resilience.monitor import HEALTH_KEYS
 
-        stop_trace_guarded()
+                        # one sample per fetch window: the window's own
+                        # average step time (validation time excluded), NOT
+                        # the cumulative run average.  The first window is
+                        # skipped — it carries the XLA compile.
+                        now = time.time()
+                        if health_window_start is not None:
+                            t_prev, s_prev, v_prev = health_window_start
+                            steps_in_window = steps_done - s_prev
+                            if steps_in_window > 0:
+                                elapsed = (now - t_prev) - (val_time - v_prev)
+                                monitor.record_step_time(
+                                    max(0.0, elapsed) / steps_in_window)
+                        health_window_start = (now, steps_done, val_time)
+                        sig = {k: float(np.asarray(mh[k]).ravel()[0])
+                               for k in HEALTH_KEYS}
+                        report = monitor.observe(steps_done, sig)
+                        if report.unhealthy and policy is not None:
+                            event = policy.assess(report)
+                            if event.action == "global-average":
+                                with rt.span("recovery_global_average",
+                                             "recovery"):
+                                    new_p, new_w = recovery(
+                                        state.params, state.gossip.ps_weight)
+                                    state = state.replace(
+                                        params=new_p,
+                                        gossip=state.gossip.replace(
+                                            ps_weight=new_w))
+                                if rt.comm is not None:
+                                    rt.comm.on_recovery()
+                    loss = float(np.mean(mh["loss"]))
+                    loss_meter.update(loss)
+                    tps = (tokens_per_step * (steps_done - start_step)
+                           / (time.time() - t0 - val_time))
+                    row = (f"{steps_done},{loss:.4f},"
+                           f"{float(np.mean(mh['ppl'])):.2f},"
+                           f"{float(np.mean(mh['lr'])):.5f},"
+                           f"{tps:.0f},"
+                           f"{float(np.mean(mh['grad_norm'])):.4f}")
+                    if moe_on:
+                        row += (",%.4f" % float(np.mean(mh['moe_dropped'])))
+                    if rt.enabled and rt.metrics_every and \
+                            steps_done - last_stats_emit >= rt.metrics_every:
+                        # step_stats ride the print-cadence metrics fetch —
+                        # the only host sync points of this loop
+                        rt.registry.emit("step_stats", {
+                            "loss": round(loss, 6),
+                            "tokens_per_sec": round(tps, 1),
+                            "grad_norm": round(
+                                float(np.mean(mh["grad_norm"])), 6)},
+                            step=steps_done)
+                        rt.emit_comm(step=steps_done)
+                        last_stats_emit = steps_done
+                    if val_on:
+                        val_due = ((args.val_every and steps_done
+                                    % args.val_every == 0)
+                                   or steps_done >= args.num_steps)
+                        if val_due:
+                            vl, vppl = run_validation(state)
+                            last_val = vl
+                            row += f",{vl:.4f},{vppl:.2f}"
+                        else:
+                            row += ",,"
+                    with open(out_fname, "a") as f:
+                        print(row, file=f)
+                if args.ckpt_every and steps_done % args.ckpt_every == 0:
+                    save_ckpt(state, steps_done)
+                    last_saved = steps_done
+                if steps_done >= args.num_steps:
+                    break
+            epoch += 1
+        if last_saved != steps_done:
+            save_ckpt(state, steps_done)
+        if use_orbax:
+            ckpt.wait()  # async saves must land before exit
+            ckpt.close()
+        if prof_started and not prof_stopped:
+            from ..utils.profiling import stop_trace_guarded
+
+            stop_trace_guarded()
+    finally:
+        # trace.json + the final comm snapshot must survive a
+        # crashed or interrupted run (same contract as the
+        # Trainer's fit() finally); finish() is idempotent
+        rt.finish(step=steps_done)
 
     result = {"final_loss": loss_meter.val, "avg_loss": loss_meter.avg,
               "tokens_per_sec": tokens_per_step

@@ -204,6 +204,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile_epochs", default=1, type=int,
                    help="trace only the first N epochs of the run "
                         "(a full-run trace is unloadable for real jobs)")
+    p.add_argument("--trace_dir", default=None, type=str,
+                   help="run telemetry directory (telemetry/): writes "
+                        "trace.json (Chrome-trace host spans: data "
+                        "fetch, compiled step, checkpoint, eval, "
+                        "recovery averages) and events.jsonl (typed "
+                        "plan/health/recovery/comm events, one "
+                        "versioned schema); analyze with "
+                        "scripts/obsreport.py.  Unset = telemetry off "
+                        "(zero overhead)")
+    p.add_argument("--metrics_every", default=0, type=int,
+                   help="emit a step_stats + comm telemetry event "
+                        "every k steps (0 = only the final comm "
+                        "snapshot); requires --trace_dir")
     return p
 
 
@@ -259,6 +272,11 @@ def parse_config(argv=None):
         parse_fault_spec(args.inject_faults)
     if args.health_every < 0:
         raise SystemExit("--health_every must be >= 0")
+    if args.metrics_every < 0:
+        raise SystemExit("--metrics_every must be >= 0")
+    if args.metrics_every and not args.trace_dir:
+        raise SystemExit("--metrics_every needs --trace_dir (telemetry "
+                         "events have nowhere to go without it)")
     # a forced name overrides the integer registry; 'auto' is resolved in
     # main() once the world size is known (planner.resolve_topology)
     graph_class = GRAPH_TOPOLOGIES[args.graph_type]
@@ -309,6 +327,8 @@ def parse_config(argv=None):
         inject_faults=args.inject_faults,
         health_every=args.health_every,
         residual_floor=args.residual_floor,
+        trace_dir=args.trace_dir,
+        metrics_every=args.metrics_every,
     )
     return cfg, args
 
@@ -329,13 +349,14 @@ def _parse_mixing_alpha(v):
     return alpha
 
 
-def _resolve_plan(cfg, args, gossip_world: int, log):
+def _resolve_plan(cfg, args, gossip_world: int, log, registry=None):
     """Apply the launch-time topology policy (planner/) to ``cfg``.
 
     Auto mode picks (and tunes) the graph; forced mode measures the
     user's choice and warns loudly when its gap is below the floor.  The
-    chosen plan is logged as one JSON line and stamped into ``cfg.plan``
-    (and from there into checkpoint metadata).
+    chosen plan is logged as one JSON line (via the telemetry registry
+    when one exists) and stamped into ``cfg.plan`` (and from there into
+    checkpoint metadata).
     """
     if cfg.all_reduce or cfg.bilat or cfg.bilat_async or gossip_world < 2:
         if args.topology == "auto" or args.mixing_alpha is not None:
@@ -359,7 +380,7 @@ def _resolve_plan(cfg, args, gossip_world: int, log):
         self_weighted=(True if args.mixing_alpha == "auto"
                        else (args.mixing_alpha or False)),
         global_avg_every=args.global_avg_every,  # None = policy decides
-        log=log)
+        log=log, registry=registry)
     cfg.graph_class = plan.graph_class
     if plan.alpha is not None:
         from ..topology import SelfWeightedMixing
@@ -404,13 +425,23 @@ def main(argv=None, config_transform=None, extra_args=None):
     log = make_logger("main", cfg.verbose)
     world = args.world_size or jax.device_count()
 
+    # run telemetry BEFORE planning, so the planner's `plan` event and
+    # the train loop share one events.jsonl (the null bundle when no
+    # --trace_dir)
+    from ..telemetry import make_run_telemetry
+
+    telemetry = make_run_telemetry(cfg.trace_dir,
+                                   rank=jax.process_index(), log=log,
+                                   metrics_every=cfg.metrics_every)
+
     # launch-time topology policy BEFORE any mesh/device work: planning is
     # pure numpy, and a below-floor warning must reach the user even when
     # the launch subsequently fails.  Gossip ranks live on the node axis
     # of a hierarchical mesh, so that's the world the mixing analysis sees
     gossip_world = (world // args.nprocs_per_node
                     if args.nprocs_per_node > 1 else world)
-    _resolve_plan(cfg, args, gossip_world, log)
+    _resolve_plan(cfg, args, gossip_world, log,
+                  registry=telemetry.registry)
 
     if args.nprocs_per_node > 1:
         cfg.nprocs_per_node = args.nprocs_per_node
@@ -495,16 +526,17 @@ def main(argv=None, config_transform=None, extra_args=None):
                       sample_input_shape=(
                           cfg.batch_size, args.image_size, args.image_size,
                           channels),
-                      cluster_manager=cluster)
+                      cluster_manager=cluster, telemetry=telemetry)
     state = trainer.init_state()
     if args.profile_dir:
         # profile a bounded window: a separate short fit() under the trace,
-        # then continue the real run untraced
+        # then continue the real run untraced.  trace_dir=None: the
+        # profile trainer must not race the real run's telemetry files
         from ..utils import trace
 
         profile_cfg = dataclasses.replace(
             cfg, num_epochs=min(args.profile_epochs, cfg.num_epochs),
-            train_fast=True, resume=False)
+            train_fast=True, resume=False, trace_dir=None)
         profile_trainer = Trainer(
             profile_cfg, model, mesh,
             sample_input_shape=(cfg.batch_size, args.image_size,

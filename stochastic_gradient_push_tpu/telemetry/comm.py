@@ -1,0 +1,275 @@
+"""Comm-volume accounting: analytic bytes-per-round for the active plan.
+
+SGP's claim is that approximate gossip averaging buys wall-clock by
+moving *less data* than exact AllReduce (PAPER.md; the error-vs-time
+framing of the AD-PSGD line of work).  The planner prices candidate
+topologies in messages and ring hops (planner/scorer.py), but until now
+nothing converted the *running* configuration — topology, mixing
+schedule, ``gossip_every`` thinning, ``global_avg_every`` exact
+averaging, fault plan — into bytes on the wire that can sit next to
+measured step time.  This module does that conversion:
+
+* :class:`CommModel` — the analytic model.  Pure integer/host math,
+  derived once from the :class:`~..topology.schedule.GossipSchedule`
+  (plus knobs), then evaluated per step.  All figures are **per-rank
+  bytes sent**:
+
+  - *gossip wire*: ``ppi × (payload + 4)`` per fired round — the SPMD
+    implementation always executes every ppermute edge (faults only
+    zero the mixing weights), so wire bytes are fault-independent; the
+    ``+ 4`` is the push-sum weight scalar riding each message.
+  - *gossip delivered*: wire bytes × the fault plan's surviving-edge
+    fraction at that tick — what actually lands in the mixing sum.
+  - *hop-weighted*: wire bytes × the phase's mean ring-hop distance
+    (planner/scorer.py's cost metric, now in bytes·hops) — the figure
+    that lets the scorer's ``hop_cost`` ranking be validated against
+    measured step time.
+  - *exact averages* (scheduled ``global_avg_every``, reactive
+    recovery, or AllReduce-every-step mode): ring-allreduce cost,
+    ``2·(n−1)/n × payload`` per rank.
+
+* :class:`CommAccountant` — the running tally the train loop feeds
+  (``on_step`` per optimizer step, ``on_recovery`` per reactive
+  average); snapshots publish as ``comm`` events through the registry.
+  By construction an accountant fed steps ``0..N-1`` reports exactly
+  :meth:`CommModel.totals`\\ ``(N)`` — the acceptance test pins that, and
+  the e2e smoke test pins the model against an independent hand count.
+
+Step/tick convention (matches algorithms.py): the tick is the 0-based
+optimizer-step counter; a gossip round fires when ``tick % gossip_every
+== 0`` with rotation phase ``(tick // gossip_every) % num_phases``; the
+scheduled exact average fires when ``(tick + 1) % global_avg_every ==
+0`` (the algorithm tests ``tick_next``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["CommModel", "CommAccountant", "tree_payload_bytes",
+           "allreduce_bytes", "PS_WEIGHT_BYTES", "COMM_CATEGORIES"]
+
+# the push-sum weight scalar that rides along with every gossip payload
+PS_WEIGHT_BYTES = 4
+
+# byte categories every snapshot reports (zero-filled when inactive)
+COMM_CATEGORIES = ("gossip_wire", "gossip_delivered", "gossip_hop_bytes",
+                   "global_avg", "recovery", "allreduce")
+
+
+def tree_payload_bytes(params, world: int = 1,
+                       itemsize: int | None = None) -> int:
+    """Bytes of one rank's full parameter payload.
+
+    ``params`` is the trainer's world-stacked pytree (leading dim =
+    ``world``); pass ``itemsize`` to price a wire-compression dtype
+    (e.g. 2 for bf16 gossip) instead of each leaf's storage dtype.
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        size = int(np.prod(np.shape(leaf))) // max(1, world)
+        isz = itemsize if itemsize is not None else np.dtype(
+            leaf.dtype).itemsize
+        total += size * isz
+    return total
+
+
+def allreduce_bytes(payload: int, world: int) -> int:
+    """Per-rank bytes sent by one exact average of ``payload`` bytes:
+    the bandwidth-optimal ring allreduce ships ``2·(n−1)/n`` of the
+    buffer per rank (reduce-scatter + all-gather)."""
+    if world <= 1:
+        return 0
+    return int(round(payload * 2 * (world - 1) / world))
+
+
+def _ring_hop(src: int, dst: int, world: int) -> int:
+    d = (dst - src) % world
+    return min(d, world - d)
+
+
+@dataclasses.dataclass(frozen=True)
+class CommModel:
+    """Analytic per-step comm cost of one running configuration."""
+
+    mode: str                       # "gossip" | "bilat" | "allreduce"
+    world: int
+    ppi: int
+    num_phases: int
+    payload_bytes: int              # gossip wire payload (comm dtype)
+    exact_bytes: int                # full-precision payload (exact avgs)
+    # per-message overhead: the push-sum weight scalar (0 for D-PSGD /
+    # bilateral exchanges, which carry no weight lane)
+    msg_overhead_bytes: int = PS_WEIGHT_BYTES
+    gossip_every: int = 1
+    global_avg_every: int = 0
+    hops_per_phase: tuple[float, ...] = ()   # mean hops/message by phase
+    # fault keep table (horizon+phases, ppi, world) as nested tuples is
+    # unwieldy; store the per-row delivered fraction instead
+    keep_fraction_rows: tuple[float, ...] = ()
+    keep_horizon: int = 0
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_schedule(cls, schedule, payload_bytes: int,
+                      exact_bytes: int | None = None,
+                      gossip_every: int = 1, global_avg_every: int = 0,
+                      faults=None, ps_weight: bool = True) -> "CommModel":
+        """Model a push-sum/D-PSGD run over ``schedule``.
+
+        ``faults`` is an optional ``resilience.FaultMasks``; its keep
+        table yields the delivered fraction per tick row.  ``ps_weight``
+        False drops the per-message weight scalar (D-PSGD).
+        """
+        n = schedule.world_size
+        hops = []
+        for p in range(schedule.num_phases):
+            total = 0
+            for i in range(schedule.peers_per_itr):
+                total += sum(
+                    _ring_hop(src, int(schedule.perms[p, i, src]), n)
+                    for src in range(n))
+            hops.append(total / max(1, n * schedule.peers_per_itr))
+        keep_rows: tuple[float, ...] = ()
+        horizon = 0
+        if faults is not None:
+            keep = faults.keep_host()  # (horizon+phases, ppi, world)
+            keep_rows = tuple(float(keep[r].mean())
+                              for r in range(keep.shape[0]))
+            horizon = int(faults.horizon)
+        return cls(mode="gossip", world=n, ppi=schedule.peers_per_itr,
+                   num_phases=schedule.num_phases,
+                   payload_bytes=int(payload_bytes),
+                   exact_bytes=int(exact_bytes if exact_bytes is not None
+                                   else payload_bytes),
+                   msg_overhead_bytes=PS_WEIGHT_BYTES if ps_weight else 0,
+                   gossip_every=max(1, int(gossip_every)),
+                   global_avg_every=max(0, int(global_avg_every)),
+                   hops_per_phase=tuple(hops),
+                   keep_fraction_rows=keep_rows, keep_horizon=horizon)
+
+    @classmethod
+    def for_allreduce(cls, world: int, payload_bytes: int) -> "CommModel":
+        """Exact AllReduce every step (the baseline SGP competes with)."""
+        return cls(mode="allreduce", world=world, ppi=0, num_phases=1,
+                   payload_bytes=int(payload_bytes),
+                   exact_bytes=int(payload_bytes))
+
+    @classmethod
+    def for_bilat(cls, world: int, payload_bytes: int) -> "CommModel":
+        """AD-PSGD bilateral averaging: one partner exchange per round
+        (per-rank send = one payload; no push-sum weight scalar)."""
+        return cls(mode="bilat", world=world, ppi=1, num_phases=1,
+                   payload_bytes=int(payload_bytes),
+                   exact_bytes=int(payload_bytes),
+                   msg_overhead_bytes=0)
+
+    # -- schedule arithmetic ----------------------------------------------
+
+    def gossip_fires(self, step: int) -> bool:
+        return self.mode in ("gossip", "bilat") \
+            and step % self.gossip_every == 0
+
+    def phase_at(self, step: int) -> int:
+        return (step // self.gossip_every) % self.num_phases
+
+    def global_avg_fires(self, step: int) -> bool:
+        return (self.mode == "gossip" and self.global_avg_every > 0
+                and (step + 1) % self.global_avg_every == 0)
+
+    def delivered_fraction(self, step: int) -> float:
+        """Surviving-edge fraction under the fault plan at this tick
+        (1.0 without faults); same row logic as FaultMasks._row."""
+        if not self.keep_fraction_rows:
+            return 1.0
+        if step < self.keep_horizon:
+            row = step
+        else:
+            row = self.keep_horizon + self.phase_at(step)
+        return self.keep_fraction_rows[row]
+
+    # -- per-step / total bytes -------------------------------------------
+
+    def step_bytes(self, step: int) -> dict:
+        """Per-rank bytes sent at optimizer step ``step`` by category."""
+        out = dict.fromkeys(COMM_CATEGORIES, 0)
+        if self.mode == "allreduce":
+            out["allreduce"] = allreduce_bytes(self.exact_bytes, self.world)
+            return out
+        if self.gossip_fires(step):
+            msg = self.payload_bytes + self.msg_overhead_bytes
+            wire = self.ppi * msg
+            out["gossip_wire"] = wire
+            out["gossip_delivered"] = int(
+                round(wire * self.delivered_fraction(step)))
+            hops = (self.hops_per_phase[self.phase_at(step)]
+                    if self.hops_per_phase else float(self.ppi))
+            out["gossip_hop_bytes"] = int(round(msg * hops))
+        if self.global_avg_fires(step):
+            out["global_avg"] = allreduce_bytes(self.exact_bytes,
+                                                self.world)
+        return out
+
+    def recovery_bytes(self) -> int:
+        """Per-rank bytes of one reactive exact global average."""
+        return allreduce_bytes(self.exact_bytes, self.world)
+
+    def totals(self, num_steps: int, start: int = 0) -> dict:
+        """Analytic expectation for steps ``start .. start+num_steps-1``."""
+        out = dict.fromkeys(COMM_CATEGORIES, 0)
+        for t in range(start, start + num_steps):
+            for k, v in self.step_bytes(t).items():
+                out[k] += v
+        return out
+
+    def to_dict(self) -> dict:
+        return {"mode": self.mode, "world": self.world, "ppi": self.ppi,
+                "num_phases": self.num_phases,
+                "payload_bytes": self.payload_bytes,
+                "exact_bytes": self.exact_bytes,
+                "msg_overhead_bytes": self.msg_overhead_bytes,
+                "gossip_every": self.gossip_every,
+                "global_avg_every": self.global_avg_every,
+                "hops_per_phase": [round(h, 4)
+                                   for h in self.hops_per_phase],
+                "faulted": bool(self.keep_fraction_rows)}
+
+
+class CommAccountant:
+    """Running per-rank comm tally the train loop feeds step by step."""
+
+    def __init__(self, model: CommModel):
+        self.model = model
+        self.totals = dict.fromkeys(COMM_CATEGORIES, 0)
+        self.steps = 0
+        self.gossip_rounds = 0
+        self.global_avgs = 0
+        self.recoveries = 0
+
+    def on_step(self, step: int) -> None:
+        """Account one optimizer step (host integer math only)."""
+        self.steps += 1
+        if self.model.gossip_fires(step):
+            self.gossip_rounds += 1
+        if self.model.global_avg_fires(step):
+            self.global_avgs += 1
+        for k, v in self.model.step_bytes(step).items():
+            self.totals[k] += v
+
+    def on_recovery(self) -> None:
+        """Account one reactive exact global average (recovery.py)."""
+        self.recoveries += 1
+        self.totals["recovery"] += self.model.recovery_bytes()
+
+    def snapshot(self) -> dict:
+        """JSON-safe state for a ``comm`` event / the final report."""
+        return {"model": self.model.to_dict(), "steps": self.steps,
+                "gossip_rounds": self.gossip_rounds,
+                "global_avgs": self.global_avgs,
+                "recoveries": self.recoveries,
+                "bytes": dict(self.totals)}

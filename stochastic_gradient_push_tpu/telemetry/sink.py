@@ -1,0 +1,88 @@
+"""Event sinks: JSONL file, legacy-line compatibility view, memory.
+
+Sinks implement one method, ``write(event)``, taking the enveloped dict
+built by :class:`~.registry.TelemetryRegistry.emit`.  ``close()`` is
+optional.  Sinks must tolerate being called from a non-main thread (the
+step watchdog emits from its timer thread), so the file sink serializes
+writes under a lock; the logging module is already thread-safe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .registry import LEGACY_PREFIXES
+
+__all__ = ["JsonlSink", "LoggerCompatSink", "MemorySink"]
+
+
+class JsonlSink:
+    """Appends one JSON line per event to ``path`` (created lazily).
+
+    Each write is flushed so a killed run still leaves a parseable
+    ``events.jsonl`` behind — the same discipline as bench.py's
+    flush-every-milestone rule.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._lock = threading.Lock()
+
+    def write(self, event: dict) -> None:
+        line = json.dumps(event, sort_keys=True, default=float)
+        with self._lock:
+            if self._f is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._f = open(self.path, "a")
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class LoggerCompatSink:
+    """Compatibility view: legacy ``gossip <kind>: {json}`` log lines.
+
+    The pre-telemetry consumers (grep pipelines, the restart harness
+    sketched in ROADMAP, tests asserting on ``gossip health:`` lines)
+    parse ``<prefix>: {sorted json}`` off stdout.  This sink re-emits
+    exactly that for the three legacy kinds — the payload is the event's
+    ``data`` verbatim, so the line is byte-identical to what the old
+    direct-logging paths produced — and stays silent for new kinds.
+    """
+
+    def __init__(self, log):
+        self.log = log
+
+    def write(self, event: dict) -> None:
+        prefix = LEGACY_PREFIXES.get(event.get("kind"))
+        if prefix is None:
+            return
+        line = f"{prefix}: " + json.dumps(event["data"], sort_keys=True,
+                                          default=float)
+        if event.get("severity") in ("warning", "error"):
+            self.log.warning(line)
+        else:
+            self.log.info(line)
+
+
+class MemorySink:
+    """Collects events in a list — tests and the obsreport selftest."""
+
+    def __init__(self):
+        self.events: list[dict] = []
+
+    def write(self, event: dict) -> None:
+        self.events.append(event)
+
+    def by_kind(self, kind: str) -> list[dict]:
+        return [e for e in self.events if e.get("kind") == kind]

@@ -111,6 +111,17 @@ class TrainerConfig:
 
     # io
     checkpoint_dir: str = "./checkpoints"
+    # telemetry (telemetry/): when set, the run writes <trace_dir>/
+    # trace.json (Chrome-trace host spans: data fetch, compiled step,
+    # checkpoint, eval, recovery averages) and <trace_dir>/events.jsonl
+    # (typed plan/health/recovery/comm/step_stats events under one
+    # versioned schema); None disables the subsystem entirely — the
+    # loop then runs the zero-overhead null telemetry (no extra clock
+    # reads, allocations, or device syncs; pinned by test)
+    trace_dir: str | None = None
+    # emit a step_stats + comm event every k steps (0 = only the final
+    # comm snapshot at exit); requires trace_dir
+    metrics_every: int = 0
     tag: str = ""
     resume: bool = False
     checkpoint_all: bool = True
@@ -158,7 +169,8 @@ class Trainer:
 
     def __init__(self, config: TrainerConfig, model, mesh,
                  sample_input_shape: tuple[int, ...],
-                 cluster_manager: ClusterManager | None = None):
+                 cluster_manager: ClusterManager | None = None,
+                 telemetry=None):
         self.cfg = config
         self.model = model
         self.mesh = mesh
@@ -195,6 +207,18 @@ class Trainer:
         self.cluster = cluster_manager
         self.sample_input_shape = sample_input_shape
 
+        # run telemetry (telemetry/): the CLI passes its already-created
+        # bundle (so the planner's `plan` event and the loop share one
+        # events.jsonl); library users get one built from the config.
+        # Without a trace_dir this is the shared zero-overhead null.
+        if telemetry is None:
+            from ..telemetry import make_run_telemetry
+
+            telemetry = make_run_telemetry(
+                config.trace_dir, rank=self.proc_index, log=self.log,
+                metrics_every=config.metrics_every)
+        self.telemetry = telemetry
+
         self.tx = sgd(momentum=config.momentum,
                       weight_decay=config.weight_decay,
                       nesterov=config.nesterov)
@@ -210,7 +234,8 @@ class Trainer:
         # flag timeout, distributed.py:36,349-352): a dead peer host shows
         # up as a hung collective, and silence is the worst failure mode
         self.watchdog = (StepWatchdog(timeout=config.heartbeat_timeout,
-                                      rank=self.proc_index)
+                                      rank=self.proc_index,
+                                      registry=self.telemetry.registry)
                          if config.heartbeat_timeout > 0 else None)
         self._async_bilat = None  # built per-fit when cfg.bilat_async
         self._warned_prefetch = False
@@ -225,7 +250,8 @@ class Trainer:
 
             self.monitor = HealthMonitor(
                 health_every=config.health_every,
-                residual_floor=config.residual_floor, log=self.log)
+                residual_floor=config.residual_floor, log=self.log,
+                registry=self.telemetry.registry)
             if not (config.all_reduce or config.bilat
                     or config.bilat_async or config.overlap):
                 # overlap mode monitors but never auto-averages (the
@@ -243,7 +269,8 @@ class Trainer:
                     algorithm="sgp" if config.push_sum else "dpsgd",
                     topology=topo,
                     residual_floor=config.residual_floor,
-                    cooldown_steps=config.health_every, log=self.log)
+                    cooldown_steps=config.health_every, log=self.log,
+                    registry=self.telemetry.registry)
 
         # per-rank files: each process writes its local ranks; the single
         # aggregate file is process 0's job
@@ -356,6 +383,48 @@ class Trainer:
                     step, self.mesh, self.gossip_axis, self.local_axis)
             self._step_cache[key] = (alg, fn)
         return self._step_cache[key]
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _setup_telemetry(self, state, itr_per_epoch: int) -> None:
+        """Attach the comm accountant for the active configuration and
+        emit the run_meta event.  Pure host work, done once per fit."""
+        from ..telemetry import CommModel, tree_payload_bytes
+
+        cfg = self.cfg
+        exact = tree_payload_bytes(state.params, self.gossip_world)
+        if cfg.all_reduce:
+            alg_name = "all_reduce"
+            model = CommModel.for_allreduce(self.gossip_world, exact)
+        elif cfg.bilat or cfg.bilat_async:
+            alg_name = "bilat_async" if cfg.bilat_async else "adpsgd"
+            model = CommModel.for_bilat(self.gossip_world, exact)
+        else:
+            alg_name = "sgp" if cfg.push_sum else "dpsgd"
+            # the epoch-0 compiled variant's own algorithm object: its
+            # schedule/faults are exactly what the wire will run (the
+            # cache entry is reused by the epoch loop, so this costs no
+            # extra construction)
+            alg = self._train_fn(ppi_at_epoch(cfg.ppi_schedule, 0),
+                                 itr_per_epoch)[0]
+            wire = (tree_payload_bytes(state.params, self.gossip_world,
+                                       itemsize=2)
+                    if cfg.gossip_comm_dtype == "bf16" else exact)
+            model = CommModel.from_schedule(
+                alg.schedule, wire, exact_bytes=exact,
+                gossip_every=alg.gossip_every,
+                global_avg_every=alg.global_avg_every,
+                faults=alg.faults, ps_weight=cfg.push_sum)
+        self.telemetry.attach_comm(model)
+        self.telemetry.registry.emit("run_meta", {
+            "world": self.gossip_world, "algorithm": alg_name,
+            "gossip_every": cfg.gossip_every,
+            "global_avg_every": cfg.global_avg_every,
+            "batch_size": cfg.batch_size,
+            "itr_per_epoch": itr_per_epoch,
+            "num_epochs": cfg.num_epochs,
+            "scan_steps": cfg.scan_steps,
+            "comm_model": model.to_dict()})
 
     # -- csv logging -------------------------------------------------------
 
@@ -500,22 +569,27 @@ class Trainer:
             self._async_bilat = AsyncBilateralAverager(
                 build_pairing_schedule(graph),
                 min_interval_s=cfg.bilat_async_interval).start()
+        if self.telemetry.enabled:
+            self._setup_telemetry(state, itr_per_epoch)
         try:
             state, best_prec1, final_prec1 = self._fit_epochs(
                 state, train_loader, sampler, val_loader, itr_per_epoch,
                 meters, start_epoch, start_itr, best_prec1, begin_time)
+
+            if cfg.train_fast and val_loader is not None:
+                alg = self._train_fn(
+                    ppi_at_epoch(cfg.ppi_schedule, cfg.num_epochs - 1)
+                    if not cfg.all_reduce else 1, itr_per_epoch)[0]
+                final_prec1 = self.validate(state, alg, val_loader)
+                self.log.info(f"Test accuracy: {final_prec1}")
         finally:
             if self._async_bilat is not None:
                 self._async_bilat.stop()
                 self.log.info("async bilateral staleness: "
                               f"{self._async_bilat.staleness_summary()}")
-
-        if cfg.train_fast and val_loader is not None:
-            alg = self._train_fn(
-                ppi_at_epoch(cfg.ppi_schedule, cfg.num_epochs - 1)
-                if not cfg.all_reduce else 1, itr_per_epoch)[0]
-            final_prec1 = self.validate(state, alg, val_loader)
-            self.log.info(f"Test accuracy: {final_prec1}")
+            # write trace.json + the final comm snapshot whatever path
+            # exits fit (idempotent; a crashed run still leaves artifacts)
+            self.telemetry.finish()
 
         result = {"best_prec1": float(best_prec1),
                   "final_prec1": float(final_prec1),
@@ -587,9 +661,16 @@ class Trainer:
                                       self.cluster.ckpt,
                                       "saves_global_state", False)
                                   else state)
-                    self.cluster.save_checkpoint(
-                        save_state, meta, epoch_id=epoch_id, is_best=is_best,
-                        requeue_on_signal=(epoch != cfg.num_epochs - 1))
+                    with self.telemetry.span("checkpoint_save",
+                                             "checkpoint",
+                                             {"epoch": epoch}
+                                             if self.telemetry.enabled
+                                             else None):
+                        self.cluster.save_checkpoint(
+                            save_state, meta, epoch_id=epoch_id,
+                            is_best=is_best,
+                            requeue_on_signal=(epoch != cfg.num_epochs
+                                               - 1))
 
         return state, best_prec1, final_prec1
 
@@ -767,6 +848,38 @@ class Trainer:
             elapsed_batch = time.time() - batch_time
             record(i + 1, slices, chunk, elapsed_nn, elapsed_batch,
                    elapsed_data, timed)
+            tel = self.telemetry
+            if tel.enabled:
+                # spans reuse the loop's OWN timestamps (no extra clock
+                # reads or syncs in the hot path); comm accounting is
+                # host integer math against the analytic model
+                gstep0 = epoch * itr_per_epoch + i + 1
+                tel.trace_complete("data_fetch", "data", batch_time,
+                                   elapsed_data)
+                span_args = {"steps": chunk, "timed": timed}
+                if tel.comm is not None:
+                    m = tel.comm.model
+                    span_args["gossip"] = sum(
+                        m.gossip_fires(gstep0 + j) for j in range(chunk))
+                    span_args["global_avg"] = sum(
+                        m.global_avg_fires(gstep0 + j)
+                        for j in range(chunk))
+                    for j in range(chunk):
+                        tel.comm.on_step(gstep0 + j)
+                tel.trace_complete("train_step", "step", nn_time,
+                                   elapsed_nn, span_args)
+                ke = tel.metrics_every
+                if ke and any((gstep0 + j) % ke == 0
+                              for j in range(chunk)):
+                    last = gstep0 + chunk - 1
+                    tel.registry.emit("step_stats", {
+                        "epoch": epoch,
+                        "loss": round(float(slices["loss"].mean()), 6),
+                        "step_time_s": round(elapsed_batch / chunk, 6),
+                        "data_time_s": round(elapsed_data / chunk, 6),
+                        "nn_time_s": round(elapsed_nn / chunk, 6),
+                        "timed": timed}, step=last)
+                    tel.emit_comm(step=last)
             if self.monitor is not None:
                 if timed:
                     # per-iteration samples feed the p50/p99 straggler view
@@ -816,11 +929,15 @@ class Trainer:
                 event = self.recovery_policy.assess(report)
                 if event.action == "global-average" \
                         and hasattr(alg, "global_average"):
-                    new_p, new_w = self._recovery_fn(alg)(
-                        state.params, state.gossip.ps_weight)
-                    state = state.replace(
-                        params=new_p,
-                        gossip=state.gossip.replace(ps_weight=new_w))
+                    with self.telemetry.span("recovery_global_average",
+                                             "recovery"):
+                        new_p, new_w = self._recovery_fn(alg)(
+                            state.params, state.gossip.ps_weight)
+                        state = state.replace(
+                            params=new_p,
+                            gossip=state.gossip.replace(ps_weight=new_w))
+                    if self.telemetry.comm is not None:
+                        self.telemetry.comm.on_recovery()
         return state
 
     def validate(self, state, algorithm, val_loader) -> float:
@@ -840,24 +957,26 @@ class Trainer:
         top5 = Meter(ptag="Prec@5")
         rank_top1 = np.zeros(self.gossip_world)
         n_batches, n_samples = 0, 0
-        for x, y in val_loader:
-            if self.proc_count > 1:
-                spec = self._batch_spec(scanned=False)
-                x = make_global_batch(self.mesh, spec, x)
-                y = make_global_batch(self.mesh, spec, y)
-            m = self._eval_fn(state, x, y)
-            if self.proc_count > 1:
-                m = to_host(m, self.mesh)
-            n = x.shape[0] * x.shape[1]
-            losses.update(float(np.mean(m["loss"])), n)
-            top1.update(float(np.mean(m["top1"])), n)
-            top5.update(float(np.mean(m["top5"])), n)
-            # sample-weighted like the aggregate Meter, so per-rank and
-            # averaged val columns agree under variable batch sizes
-            rank_top1 += np.asarray(m["top1"]).reshape(
-                self.gossip_world) * n
-            n_samples += n
-            n_batches += 1
+        with self.telemetry.span("validate", "eval"):
+            for x, y in val_loader:
+                if self.proc_count > 1:
+                    spec = self._batch_spec(scanned=False)
+                    x = make_global_batch(self.mesh, spec, x)
+                    y = make_global_batch(self.mesh, spec, y)
+                m = self._eval_fn(state, x, y)
+                if self.proc_count > 1:
+                    m = to_host(m, self.mesh)
+                n = x.shape[0] * x.shape[1]
+                losses.update(float(np.mean(m["loss"])), n)
+                top1.update(float(np.mean(m["top1"])), n)
+                top5.update(float(np.mean(m["top5"])), n)
+                # sample-weighted like the aggregate Meter, so per-rank
+                # and averaged val columns agree under variable batch
+                # sizes
+                rank_top1 += np.asarray(m["top1"]).reshape(
+                    self.gossip_world) * n
+                n_samples += n
+                n_batches += 1
         if n_batches == 0:
             self.log.warning(
                 "validation loader yielded no batches (dataset smaller "

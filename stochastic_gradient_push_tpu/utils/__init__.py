@@ -8,7 +8,7 @@ from .flatten import (
     is_power_of,
     unflatten_tensors,
 )
-from .logging import make_logger
+from .logging import make_logger, reset_logger
 from .meter import Meter, PercentileMeter
 from .profiling import HEARTBEAT_TIMEOUT, StepWatchdog, trace
 
@@ -16,6 +16,7 @@ __all__ = [
     "Meter",
     "PercentileMeter",
     "make_logger",
+    "reset_logger",
     "flatten_tensors",
     "unflatten_tensors",
     "group_by_dtype",

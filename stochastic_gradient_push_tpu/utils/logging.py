@@ -10,7 +10,7 @@ from __future__ import annotations
 import logging
 import sys
 
-__all__ = ["make_logger"]
+__all__ = ["make_logger", "reset_logger"]
 
 
 def make_logger(rank: int | str, verbose: bool = True) -> logging.Logger:
@@ -25,4 +25,22 @@ def make_logger(rank: int | str, verbose: bool = True) -> logging.Logger:
         logger.propagate = False
         logger.handler_set = True
     logger.setLevel(logging.DEBUG if verbose else logging.INFO)
+    return logger
+
+
+def reset_logger(rank: int | str) -> logging.Logger:
+    """Drop the rank logger's latched handler so the NEXT ``make_logger``
+    call re-binds to the *current* ``sys.stdout``.
+
+    ``make_logger`` latches its stream handler on first creation — the
+    right behavior for a long-lived process, but fd-capture tests that
+    swap stdout (pytest's ``capfd``) would otherwise keep logging into a
+    previous test's captured stream.  This is the public re-bind hook
+    those tests use instead of reaching into handler internals.
+    """
+    logger = logging.getLogger(f"{__name__}.rank{rank}")
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        h.close()
+    logger.handler_set = None
     return logger

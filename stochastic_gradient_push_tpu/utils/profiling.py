@@ -189,13 +189,20 @@ class StepWatchdog:
         with wd.step():
             state, metrics = train_fn(state, x, y)
             jax.block_until_ready(state)
+
+    With a telemetry ``registry`` attached, every stall additionally
+    lands as a structured ``heartbeat`` event in ``events.jsonl`` (the
+    plain-text error line alone was invisible to any tooling; the
+    obsreport counts these events as the run's stall record).
     """
 
     def __init__(self, timeout: float = HEARTBEAT_TIMEOUT, rank: int = 0,
-                 abort_on_timeout: bool = False):
+                 abort_on_timeout: bool = False, registry=None):
         self.timeout = timeout
         self.abort_on_timeout = abort_on_timeout
+        self.rank = rank
         self.logger = make_logger(rank)
+        self.registry = registry
         self.timed_out = False
 
     @contextlib.contextmanager
@@ -211,6 +218,15 @@ class StepWatchdog:
                     f"step exceeded heartbeat timeout "
                     f"({elapsed:.0f}s > {self.timeout}s) — device stall, "
                     "or an unreachable peer host on multi-host runs")
+                if self.registry is not None:
+                    # sinks are thread-safe; this runs on the watchdog
+                    # thread while the main thread is (by definition)
+                    # stuck in the blocking step
+                    self.registry.emit(
+                        "heartbeat",
+                        {"elapsed_s": round(elapsed, 3),
+                         "timeout_s": self.timeout, "rank": self.rank},
+                        severity="error")
                 if self.abort_on_timeout:
                     import os
                     os._exit(70)

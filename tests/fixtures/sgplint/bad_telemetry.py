@@ -1,0 +1,59 @@
+"""SGPL009: telemetry span/event emission reachable from jitted code.
+
+A span opened inside a traced function times *tracing* (once, at
+compile), and an event emitted there fires once and never again per
+step — both are host-side operations that belong around the compiled
+call, not inside it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class _FakeTelemetry:
+    # stands in for telemetry.RunTelemetry / TelemetryRegistry — the
+    # rule matches the emission surface by attribute name, exactly
+    # because the real objects arrive as arguments, not imports
+    def span(self, name, phase="step", args=None):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def emit(self, kind, data, step=None, severity="info"):
+        return data
+
+    def trace_complete(self, name, phase, start, dur, args=None):
+        pass
+
+
+TEL = _FakeTelemetry()
+
+
+@jax.jit
+def traced_step(x):
+    with TEL.span("train_step", "step"):  # EXPECT: SGPL009
+        y = x * 2.0
+    TEL.emit("step_stats", {"loss": 0.0})  # EXPECT: SGPL009
+    TEL.trace_complete("fetch", "data", 0.0, 0.1)  # EXPECT: SGPL009
+    return y
+
+
+def helper(x):
+    # called from the traced function below -> traced by propagation
+    TEL.emit("comm", {})  # EXPECT: SGPL009
+    return x
+
+
+def outer(x):
+    return helper(x) + 1.0
+
+
+outer_jit = jax.jit(outer)
+
+
+def host_loop(x):
+    # NOT traced: emitting around the compiled call is the whole point
+    with TEL.span("train_step", "step"):
+        y = jnp.asarray(x) * 2.0
+    TEL.emit("step_stats", {"loss": float(y.sum())})
+    return y

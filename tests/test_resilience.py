@@ -570,16 +570,14 @@ def test_sgd_cli_chaos_end_to_end(tmp_path, capfd):
     """Whole-stack: CLI flags -> faulted compiled step -> health lines ->
     recovery -> checkpoint written.  The project logger writes to stdout
     with propagate=False (utils/logging.py), so capture at the fd."""
-    import stochastic_gradient_push_tpu.utils.logging as ulog
     from stochastic_gradient_push_tpu.run.gossip_sgd import main
+    from stochastic_gradient_push_tpu.utils import reset_logger
 
     # make_logger latches its stream at first creation; an earlier test
     # may have created these loggers under ITS captured stdout — rebind
+    # via the public hook (utils/logging.py reset_logger)
     for name in ("main", "trainer"):
-        lg = logging.getLogger(f"{ulog.__name__}.rank{name}")
-        for h in list(lg.handlers):
-            lg.removeHandler(h)
-        lg.handler_set = None
+        reset_logger(name)
     main(["--dataset", "synthetic", "--model", "tiny_cnn",
           "--num_classes", "10", "--image_size", "16",
           "--batch_size", "4", "--world_size", "8",
