@@ -52,7 +52,12 @@ Secondary mode — ``python bench.py --gossip-vs-ar`` (ROADMAP's
 averaging against AllReduce-every-step on a world-8 virtual CPU mesh,
 instrumented through the telemetry span tracer, and writes a BENCH-style
 JSON artifact (default artifacts/bench_gossip_vs_ar.json; knobs
-BENCH_GVA_WORLD/BATCH/STEPS/WARMUP/GA/OUT).
+BENCH_GVA_WORLD/BATCH/STEPS/WARMUP/GA/OUT).  ``--topology NAME``
+(or BENCH_GVA_TOPOLOGY) selects the gossip graph — ``hierarchical``
+times the two-level multi-slice schedule against the AR baseline, and
+the artifact stamps the modeled per-link-class (ICI vs DCN) bytes next
+to the measured milliseconds so the planner's DCN weighting can be
+calibrated against real step time.
 """
 
 import json
@@ -385,7 +390,7 @@ def run_gossip_vs_ar() -> dict:
     from stochastic_gradient_push_tpu.telemetry import (
         CommModel, SpanTracer, tree_payload_bytes)
     from stochastic_gradient_push_tpu.topology import (
-        RingGraph, build_schedule)
+        TOPOLOGY_NAMES, build_schedule)
     from stochastic_gradient_push_tpu.train import (
         LRSchedule, build_train_step, init_train_state, replicate_state,
         sgd, shard_train_step)
@@ -398,13 +403,18 @@ def run_gossip_vs_ar() -> dict:
     steps = max(1, int(os.environ.get("BENCH_GVA_STEPS", "20")))
     warmup = max(1, int(os.environ.get("BENCH_GVA_WARMUP", "3")))
     ga = max(1, int(os.environ.get("BENCH_GVA_GA", "8")))
+    topology = os.environ.get("BENCH_GVA_TOPOLOGY", "ring")
     image, classes = 16, 10
 
     mesh = make_gossip_mesh(world)
     model = TinyCNN(num_classes=classes)
     tx = sgd(momentum=0.9, weight_decay=1e-4)
     lr_sched = LRSchedule(ref_lr=0.1, batch_size=batch, world_size=world)
-    schedule = build_schedule(RingGraph(world, peers_per_itr=1))
+    if topology not in TOPOLOGY_NAMES:
+        raise SystemExit(f"unknown --topology {topology!r}; one of "
+                         f"{sorted(TOPOLOGY_NAMES)}")
+    schedule = build_schedule(
+        TOPOLOGY_NAMES[topology](world, peers_per_itr=1))
     tracer = SpanTracer(rank=0)
     serialize = jax.default_backend() == "cpu"
 
@@ -462,6 +472,7 @@ def run_gossip_vs_ar() -> dict:
         "ar_step_ms": round(ar_ms, 3),
         "speedup_vs_ar": round(ar_ms / sgp_ms, 3) if sgp_ms else None,
         "global_avg_every": ga,
+        "topology": topology,
         "world": world,
         "batch": batch,
         "steps": steps,
@@ -469,6 +480,11 @@ def run_gossip_vs_ar() -> dict:
         "payload_bytes": payload,
         "modeled_bytes_per_rank": {
             "sgp_ga": sgp_bytes["gossip_wire"] + sgp_bytes["global_avg"],
+            # the wire split by link class (hierarchical runs put their
+            # intra-slice exact average on ICI, delegate gossip on DCN;
+            # flat single-slice schedules are all-ICI)
+            "gossip_ici": sgp_bytes["gossip_ici"],
+            "gossip_dcn": sgp_bytes["gossip_dcn"],
             "allreduce": ar_bytes["allreduce"],
         },
     }
@@ -483,12 +499,31 @@ def run_gossip_vs_ar() -> dict:
     return out
 
 
+def _gva_topology_arg(argv: list[str]) -> str | None:
+    """``--topology NAME`` / ``--topology=NAME`` from a raw argv (no
+    argparse in the parent — it must stay transparent to child flags).
+    Raises SystemExit on a dangling ``--topology``."""
+    for i, arg in enumerate(argv):
+        if arg == "--topology":
+            if i + 1 >= len(argv):
+                print("--topology needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            return argv[i + 1]
+        if arg.startswith("--topology="):
+            return arg.split("=", 1)[1]
+    return None
+
+
 def gossip_vs_ar_main() -> int:
     """Parent for --gossip-vs-ar: re-exec as a child on a world-8
     virtual CPU mesh (the device-count flag must be set before jax
-    loads, hence the subprocess)."""
+    loads, hence the subprocess).  ``--topology NAME`` rides into the
+    child as BENCH_GVA_TOPOLOGY (hierarchical-vs-flat timing)."""
     env = _child_env(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    topology = _gva_topology_arg(sys.argv)
+    if topology is not None:
+        env["BENCH_GVA_TOPOLOGY"] = topology
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (

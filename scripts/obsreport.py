@@ -285,6 +285,16 @@ def render(report: dict) -> str:
         for k, v in sorted(by.items()):
             if v:
                 lines.append(f"   {k:>18}: {v:,}")
+        if by.get("gossip_dcn"):
+            # the split the hierarchical topology exists to improve:
+            # gossip wire by link class (planner/interconnect.py fabric)
+            wire = max(1, by.get("gossip_wire", 0))
+            lines.append(
+                "   link classes: ICI "
+                f"{by.get('gossip_ici', 0):,} "
+                f"({100 * by.get('gossip_ici', 0) / wire:.0f}%) vs DCN "
+                f"{by['gossip_dcn']:,} "
+                f"({100 * by['gossip_dcn'] / wire:.0f}%) of gossip wire")
     meta = report["ckpt_meta"]
     if meta:
         keys = sorted(k for k in meta if not k.startswith("_"))

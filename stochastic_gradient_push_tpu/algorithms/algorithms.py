@@ -86,6 +86,22 @@ class PushSumGossip(GossipAlgorithm):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
+        from ..topology.hierarchical import HierarchicalSchedule
+
+        if isinstance(schedule, HierarchicalSchedule):
+            # two-level rounds compile to leader ppermute + grouped psum
+            # (collectives._hier_round_fn); neither the overlap split nor
+            # per-edge fault masks decompose across that psum
+            if overlap:
+                raise ValueError(
+                    "overlap mode is not supported on hierarchical "
+                    "schedules: the intra-slice exact average cannot be "
+                    "deferred as an in-flight share")
+            if faults is not None:
+                raise ValueError(
+                    "inject_faults is not supported on hierarchical "
+                    "schedules: the intra-slice psum has no per-edge "
+                    "mask (use a flat topology for fault drills)")
         # deterministic fault injection (resilience/faults.py FaultMasks):
         # the mixing boundary applies the plan's keep/corrupt masks with
         # mass-conserving reabsorption.  Synchronous mode only — an

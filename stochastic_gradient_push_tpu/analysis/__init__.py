@@ -29,6 +29,9 @@ from .verifier import (
     verify_schedule,
     verify_pairing,
     spectral_gap,
+    spectral_gap_cache_clear,
+    spectral_gap_cache_info,
+    schedule_fingerprint,
     GapEntry,
     is_unsupported_config,
     DEFAULT_WORLD_SIZES,
@@ -50,6 +53,12 @@ __all__ = [
     # predicate.  The planner (planner/scorer.py) builds on these instead
     # of duplicating the eigenvalue machinery or the skip rules.
     "spectral_gap",
+    # spectral-gap memoization: fingerprint key + cache introspection
+    # (the 510-config verifier sweep and repeated plan_for calls in one
+    # process share eigenvalue solves through this cache)
+    "schedule_fingerprint",
+    "spectral_gap_cache_clear",
+    "spectral_gap_cache_info",
     "GapEntry",
     "is_unsupported_config",
     "DEFAULT_WORLD_SIZES",

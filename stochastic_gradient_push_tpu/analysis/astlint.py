@@ -16,7 +16,14 @@ Traced-code discovery (the scope for SGPL002/003/004/008):
   the module (including nested wraps like ``jax.jit(shard_map(f, ...))``);
 * functions lexically nested inside a traced function;
 * local functions *called by name* from a traced function (one-module
-  call-graph closure — the ``step_fn``-builder idiom).
+  call-graph closure — the ``step_fn``-builder idiom);
+* helpers **one import hop away** (:func:`lint_paths` only): a traced
+  function calling ``helper`` imported ``from .sibling import helper``
+  (or ``sib.helper(...)`` through a module import) marks ``helper``
+  traced *in its own module*, where the local closure then continues.
+  Exactly one hop — a helper's own cross-module calls do not propagate
+  further (precision over recall: each hop multiplies false-positive
+  risk through aliasing).
 
 Suppressions: a ``# sgplint: disable=SGPL007`` (comma-separated ids, or
 ``all``) comment on the finding's line or the line directly above it.
@@ -111,6 +118,9 @@ class _Module:
         self.tree = tree
         self.aliases: dict[str, str] = {}     # local name -> canonical prefix
         self.constants: dict[str, str] = {}   # module-level NAME -> str value
+        # every from-import, relative ones included, for the cross-module
+        # closure: (level, module, imported name, local alias)
+        self.from_imports: list[tuple[int, str, str, str]] = []
         self._collect_imports()
         self._collect_constants()
 
@@ -120,11 +130,16 @@ class _Module:
                 for a in node.names:
                     self.aliases[a.asname or a.name.split(".")[0]] = (
                         a.name if a.asname else a.name.split(".")[0])
-            elif isinstance(node, ast.ImportFrom) and node.module \
-                    and node.level == 0:
+            elif isinstance(node, ast.ImportFrom):
                 for a in node.names:
-                    self.aliases[a.asname or a.name] = (
-                        f"{node.module}.{a.name}")
+                    if a.name != "*":
+                        self.from_imports.append(
+                            (node.level, node.module or "", a.name,
+                             a.asname or a.name))
+                if node.module and node.level == 0:
+                    for a in node.names:
+                        self.aliases[a.asname or a.name] = (
+                            f"{node.module}.{a.name}")
 
     def _collect_constants(self) -> None:
         for node in self.tree.body:
@@ -176,14 +191,26 @@ def _func_name_args(mod: _Module, call: ast.Call):
     return fn, call.args
 
 
-def _collect_traced(mod: _Module) -> set[ast.AST]:
-    """Function nodes whose bodies execute under tracing."""
+def _collect_traced(mod: _Module,
+                    seeds: frozenset = frozenset()) -> set[ast.AST]:
+    """Function nodes whose bodies execute under tracing.
+
+    ``seeds`` are function *names* known traced from outside this module
+    (the cross-module closure in :func:`lint_paths`); they join the
+    in-module fixpoint like any decorator-traced function.
+    """
     funcs: dict[str, list[ast.AST]] = {}
     traced: set[ast.AST] = set()
 
+    # a from-import can only bind a module-top-level name, so seeds must
+    # not match same-named class methods or nested functions
+    top_level = {n for n in mod.tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             funcs.setdefault(node.name, []).append(node)
+            if node.name in seeds and node in top_level:
+                traced.add(node)
             for dec in node.decorator_list:
                 target = dec.func if isinstance(dec, ast.Call) else dec
                 name = mod.canonical(target)
@@ -283,11 +310,12 @@ def collect_axis_vocabulary(paths) -> set[str]:
 
 
 class _Linter(ast.NodeVisitor):
-    def __init__(self, mod: _Module, axes: set[str], relpath: str):
+    def __init__(self, mod: _Module, axes: set[str], relpath: str,
+                 extra_traced: frozenset = frozenset()):
         self.mod = mod
         self.axes = axes
         self.relpath = relpath
-        self.traced = _collect_traced(mod)
+        self.traced = _collect_traced(mod, extra_traced)
         self.findings: list[Finding] = []
         self._fn_stack: list[ast.AST] = []
 
@@ -574,24 +602,121 @@ class _Linter(ast.NodeVisitor):
                 donated_at.pop(node.id)
 
 
+def _resolve_import(entry_path: str, level: int, module: str,
+                    known: set[str]) -> str | None:
+    """File (in ``known``, abspaths) a from-import's module refers to.
+
+    Relative imports resolve on the filesystem from the importing file's
+    package; absolute imports match the dotted path as a file-path
+    suffix, and only when exactly one known file matches (ambiguity →
+    no resolution: the closure prefers silence to a wrong edge).
+    """
+    if level:
+        base = os.path.dirname(os.path.abspath(entry_path))
+        for _ in range(level - 1):
+            base = os.path.dirname(base)
+        cand = os.path.join(base, *module.split(".")) if module else base
+        for c in (cand + ".py", os.path.join(cand, "__init__.py")):
+            if c in known:
+                return c
+        return None
+    if not module:
+        return None
+    tail = os.path.join(*module.split("."))
+    hits = [p for p in known
+            if p.endswith(os.sep + tail + ".py")
+            or p.endswith(os.sep + os.path.join(tail, "__init__.py"))]
+    return hits[0] if len(hits) == 1 else None
+
+
+def _cross_module_seeds(mods: dict[str, _Module]) -> dict[str, set[str]]:
+    """One-import-hop closure: for every module, the function names its
+    siblings' traced code calls through an import.
+
+    Handles ``from .sib import helper; helper(x)`` (name call) and
+    ``from . import sib; sib.helper(x)`` (module-attribute call), plus
+    helpers handed straight to a tracing wrapper (``jax.jit(helper)``).
+    Seeds come only from each module's *own* traced set, so tracedness
+    propagates exactly one hop.
+    """
+    known = set(mods)
+    seeds: dict[str, set[str]] = {p: set() for p in known}
+    for apath, mod in mods.items():
+        name_imports: dict[str, tuple[str, str]] = {}
+        mod_imports: dict[str, str] = {}
+        for level, module, orig, alias in mod.from_imports:
+            sub = f"{module}.{orig}" if module else orig
+            target = _resolve_import(apath, level, sub, known)
+            if target is not None:       # `orig` IS a module
+                mod_imports[alias] = target
+                continue
+            target = _resolve_import(apath, level, module, known)
+            if target is not None and target != apath:
+                name_imports[alias] = (target, orig)
+        if not (name_imports or mod_imports):
+            continue
+
+        def mark(call: ast.Call) -> None:
+            f = call.func
+            if isinstance(f, ast.Name) and f.id in name_imports:
+                target, orig = name_imports[f.id]
+                seeds[target].add(orig)
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in mod_imports:
+                seeds[mod_imports[f.value.id]].add(f.attr)
+
+        for fn in _collect_traced(mod):
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    mark(n)
+        # an imported helper handed to a tracing wrapper anywhere in the
+        # module (jax.jit(helper), shard_map(helper, ...)) is traced too
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call):
+                fn_name, args = _func_name_args(mod, n)
+                if fn_name in _TRACING_WRAPPERS and args \
+                        and isinstance(args[0], ast.Name) \
+                        and args[0].id in name_imports:
+                    target, orig = name_imports[args[0].id]
+                    seeds[target].add(orig)
+    return seeds
+
+
+def _lint_mod(mod: _Module, axes: set[str], relpath: str,
+              extra_traced: frozenset = frozenset()) -> list[Finding]:
+    linter = _Linter(mod, axes, relpath, extra_traced)
+    linter.visit(mod.tree)
+    return sorted(linter.findings)
+
+
 def lint_file(path: str, axes: set[str], relto: str | None = None
               ) -> list[Finding]:
+    """Lint one file in isolation (no cross-module closure — use
+    :func:`lint_paths` for that)."""
     source = open(path).read()
     tree = ast.parse(source, filename=path)
     rel = os.path.relpath(path, relto) if relto else path
-    mod = _Module(path, source, tree)
-    linter = _Linter(mod, axes, rel)
-    linter.visit(tree)
-    return sorted(linter.findings)
+    return _lint_mod(_Module(path, source, tree), axes, rel)
 
 
 def lint_paths(paths, axes: set[str] | None = None,
                relto: str | None = None) -> list[Finding]:
     """Lint every ``.py`` under ``paths``; axis vocabulary defaults to
-    what the same paths declare."""
+    what the same paths declare.  Linting a file *set* enables the
+    cross-module call-graph closure: helpers one import hop from traced
+    code are linted as traced in their own module."""
     if axes is None:
         axes = collect_axis_vocabulary(paths)
-    findings: list[Finding] = []
+    mods: dict[str, _Module] = {}
     for f in iter_py_files(paths):
-        findings.extend(lint_file(f, axes, relto=relto))
+        source = open(f).read()
+        tree = ast.parse(source, filename=f)
+        mods[os.path.abspath(f)] = _Module(f, source, tree)
+    seeds = _cross_module_seeds(mods)
+    findings: list[Finding] = []
+    for apath, mod in mods.items():
+        rel = os.path.relpath(mod.path, relto) if relto else mod.path
+        findings.extend(_lint_mod(mod, axes, rel,
+                                  frozenset(seeds.get(apath, ()))))
     return sorted(findings)

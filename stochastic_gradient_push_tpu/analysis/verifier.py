@@ -26,6 +26,7 @@ All checks run on CPU in seconds: tables are numpy, never traced.
 
 from __future__ import annotations
 
+import hashlib
 import inspect
 
 import numpy as np
@@ -34,7 +35,8 @@ from .findings import Finding
 
 __all__ = ["verify_schedule", "verify_pairing", "verify_topology",
            "verify_module", "verify_package", "DEFAULT_WORLD_SIZES",
-           "GapEntry", "is_unsupported_config"]
+           "GapEntry", "is_unsupported_config", "schedule_fingerprint",
+           "spectral_gap_cache_clear", "spectral_gap_cache_info"]
 
 # 2..64 per the convergence-grid contract: powers of two (pod slices),
 # odd/even non-powers (the shapes that break naive schedules)
@@ -89,14 +91,67 @@ def _mixing_matrix(schedule, phase: int) -> np.ndarray:
     return w
 
 
+def schedule_fingerprint(schedule) -> bytes:
+    """Content hash of a schedule's mixing tables.
+
+    Two schedules with identical ``perms``/``self_weight``/
+    ``edge_weights`` (shapes included) have identical rotation-cycle
+    products, so the fingerprint is a sound memoization key for every
+    quantity derived from the cycle — in particular the spectral gap.
+    """
+    perms = np.ascontiguousarray(np.asarray(schedule.perms,
+                                            dtype=np.int64))
+    self_w = np.ascontiguousarray(np.asarray(schedule.self_weight,
+                                             dtype=np.float64))
+    edge_w = np.ascontiguousarray(np.asarray(schedule.edge_weights,
+                                             dtype=np.float64))
+    h = hashlib.sha1()
+    h.update(repr((perms.shape, self_w.shape, edge_w.shape)).encode())
+    h.update(perms.tobytes())
+    h.update(self_w.tobytes())
+    h.update(edge_w.tobytes())
+    return h.digest()
+
+
+# spectral-gap memo: the verifier's full grid and the planner's candidate
+# scoring rebuild identical schedules many times per process (sgplint's
+# sweep alone visits hundreds of configurations; every plan_for call
+# rescans the candidate grid).  The eigenvalue solve dominates, so cache
+# gap by table fingerprint.  Entries are one float per digest — unbounded
+# growth is not a concern at any realistic schedule count.
+_GAP_CACHE: dict[bytes, float] = {}
+_GAP_STATS = {"hits": 0, "misses": 0}
+
+
+def spectral_gap_cache_info() -> dict:
+    """{'hits', 'misses', 'size'} of the spectral-gap memo (testing /
+    diagnostics)."""
+    return {"hits": _GAP_STATS["hits"], "misses": _GAP_STATS["misses"],
+            "size": len(_GAP_CACHE)}
+
+
+def spectral_gap_cache_clear() -> None:
+    _GAP_CACHE.clear()
+    _GAP_STATS["hits"] = _GAP_STATS["misses"] = 0
+
+
 def spectral_gap(schedule) -> float:
-    """``1 - |λ₂|`` of the full rotation-cycle product."""
+    """``1 - |λ₂|`` of the full rotation-cycle product (memoized by
+    :func:`schedule_fingerprint`)."""
+    fp = schedule_fingerprint(schedule)
+    cached = _GAP_CACHE.get(fp)
+    if cached is not None:
+        _GAP_STATS["hits"] += 1
+        return cached
+    _GAP_STATS["misses"] += 1
     n = schedule.world_size
     prod = np.eye(n)
     for p in range(schedule.num_phases):
         prod = _mixing_matrix(schedule, p) @ prod
     lam = np.sort(np.abs(np.linalg.eigvals(prod)))[::-1]
-    return float(1.0 - (lam[1] if n > 1 else 0.0))
+    gap = float(1.0 - (lam[1] if n > 1 else 0.0))
+    _GAP_CACHE[fp] = gap
+    return gap
 
 
 def verify_schedule(schedule, label: str, file: str, line: int

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..topology.hierarchical import HierarchicalSchedule
 from ..topology.schedule import GossipSchedule
 
 __all__ = [
@@ -145,6 +146,28 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
     return fn
 
 
+def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
+                   axis_name: str, comm_dtype=None):
+    """One compiled hierarchical round: leader ppermute, then the exact
+    intra-slice average as ONE grouped ``psum`` over the slice sub-axis
+    (ICI-local; the ``slice_size − 1`` rotate-permutations of the table
+    representation collapse into a single collective).  Numerically this
+    applies exactly ``W_intra @ W_inter(round)`` — the matrices the
+    verifier checks."""
+    inter = _round_fn(hsched.inter_schedule, round_idx, axis_name,
+                      comm_dtype)
+    groups = [list(g) for g in hsched.slice_groups]
+    inv_s = 1.0 / hsched.slice_size
+
+    def mix(tree):
+        t = inter(tree)
+        return jax.tree.map(
+            lambda a: lax.psum(a * jnp.asarray(inv_s, a.dtype), axis_name,
+                               axis_index_groups=groups), t)
+
+    return mix
+
+
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
                  comm_dtype=None, faults=None, tick=None):
     """One synchronous gossip round over an arbitrary pytree.
@@ -156,12 +179,24 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
     churn.  ``comm_dtype`` compresses the wire payload (see
     :func:`_round_fn`).
 
+    A :class:`~..topology.hierarchical.HierarchicalSchedule` compiles to
+    its two-level form: leader ``ppermute`` across slices plus one grouped
+    ``psum`` inside each slice per round (see :func:`_hier_round_fn`);
+    ``phase`` then counts *rounds*, each spanning two table phases.
+
     ``faults`` applies a compiled fault plan (resilience/faults.py) with
     mass-conserving drop semantics; ``tick`` is the fault-time index (a
     traced step counter, defaults to ``phase`` — they coincide except
     under communication thinning, where the rotation advances slower than
     the step clock).
     """
+    if isinstance(schedule, HierarchicalSchedule) and faults is not None:
+        # static configuration error: reject before any axis
+        # introspection so the message survives outside a mesh context
+        raise ValueError(
+            "fault injection is not supported on hierarchical "
+            "schedules: the intra-slice psum has no per-edge mask "
+            "(use a flat topology for fault drills)")
     axis_size = lax.axis_size(axis_name)
     if axis_size != schedule.world_size:
         raise ValueError(
@@ -169,6 +204,13 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
             f"mesh axis '{axis_name}' has size {axis_size}")
     if schedule.world_size == 1:
         return tree
+    if isinstance(schedule, HierarchicalSchedule):
+        rounds = schedule.rounds_per_cycle
+        if rounds == 1:
+            return _hier_round_fn(schedule, 0, axis_name, comm_dtype)(tree)
+        branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype)
+                    for q in range(rounds)]
+        return lax.switch(as_scalar(phase) % rounds, branches, tree)
     if faults is not None:
         tick = as_scalar(phase if tick is None else tick)
         operand = (tree, tick)

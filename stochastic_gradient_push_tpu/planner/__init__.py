@@ -5,8 +5,13 @@ every registered topology's rotation-cycle spectral gap is computed on CPU
 in milliseconds.  This package makes it *actionable* at launch:
 
 * :mod:`.scorer` — enumerate and rank every (topology × peers_per_itr)
-  candidate for a world size by gap and a per-phase communication-cost
+  candidate for a world size by gap and a priced communication-cost
   model;
+* :mod:`.interconnect` — the torus-aware fabric cost model pricing each
+  edge: ICI torus hops inside a slice, a flat (configurable, typically
+  ~16×) DCN weight across slices — what lets the two-level
+  ``hierarchical`` topology outrank flat graphs exactly when the fabric
+  says DCN dominates;
 * :mod:`.alpha` — co-optimize the SelfWeightedMixing alpha against the
   chosen topology (a small scalar search) instead of taking it as a free
   knob;
@@ -24,6 +29,12 @@ so planning is free at launch and the CLI runs anywhere.
 """
 
 from .alpha import alpha_gap, optimize_alpha
+from .interconnect import (
+    DEFAULT_DCN_COST,
+    DEFAULT_ICI_COST,
+    InterconnectModel,
+    make_interconnect,
+)
 from .policy import (
     DEFAULT_GAP_FLOOR,
     Plan,
@@ -36,20 +47,26 @@ from .scorer import (
     Candidate,
     DEFAULT_PEER_COUNTS,
     consensus_cost,
+    cycle_cost,
     evaluate_candidate,
     score_candidates,
 )
 
 __all__ = [
+    "DEFAULT_DCN_COST",
     "DEFAULT_GAP_FLOOR",
+    "DEFAULT_ICI_COST",
     "DEFAULT_PEER_COUNTS",
     "Candidate",
+    "InterconnectModel",
     "Plan",
     "PlanConstraints",
     "alpha_gap",
     "check_topology",
     "consensus_cost",
+    "cycle_cost",
     "evaluate_candidate",
+    "make_interconnect",
     "optimize_alpha",
     "plan_for",
     "resolve_topology",

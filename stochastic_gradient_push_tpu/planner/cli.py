@@ -17,6 +17,7 @@ import argparse
 import json
 import sys
 
+from .interconnect import make_interconnect
 from .policy import (
     DEFAULT_GAP_FLOOR,
     PlanConstraints,
@@ -26,17 +27,21 @@ from .policy import (
 from .scorer import DEFAULT_PEER_COUNTS, score_candidates
 
 
-def _print_table(cands, floor: float) -> None:
+def _fmt(v: float, width: int = 10) -> str:
+    return f"{v:{width}.1f}" if v != float("inf") else f"{'inf':>{width}}"
+
+
+def _print_table(cands, floor: float, priced: bool = False) -> None:
+    extra = f" {'priced':>10} {'ici':>10} {'dcn':>10}" if priced else ""
     print(f"{'topology':<24} {'ppi':>3} {'gap':>8} {'phases':>6} "
-          f"{'msgs/efold':>10} {'hops/efold':>10}  floor")
+          f"{'msgs/efold':>10} {'hops/efold':>10}{extra}  floor")
     for c in cands:
-        cost = f"{c.comm_cost:10.1f}" if c.comm_cost != float("inf") \
-            else f"{'inf':>10}"
-        hops = f"{c.hop_cost:10.1f}" if c.hop_cost != float("inf") \
-            else f"{'inf':>10}"
         mark = "ok" if c.meets(floor) else "BELOW"
+        extra = (f" {_fmt(c.priced_cost)} {_fmt(c.ici_per_efold)} "
+                 f"{_fmt(c.dcn_per_efold)}" if priced else "")
         print(f"{c.topology:<24} {c.ppi:>3} {c.gap:>8.4f} "
-              f"{c.num_phases:>6} {cost} {hops}  {mark}")
+              f"{c.num_phases:>6} {_fmt(c.comm_cost)} "
+              f"{_fmt(c.hop_cost)}{extra}  {mark}")
 
 
 def _selftest(world: int, floor: float) -> int:
@@ -81,6 +86,36 @@ def _selftest(world: int, floor: float) -> int:
           f"{topology_name(type(g))}")
     check(0.0 < tuned_alpha < 1.0, "optimized alpha outside (0, 1)")
 
+    # hierarchical candidate: a DCN-dominant fabric must flip the world-64
+    # winner to the two-level graph (and its schedule must verify), while
+    # a uniform fabric must keep a flat winner — the interconnect model's
+    # whole point
+    from ..analysis import verify_schedule
+    from ..topology import HierarchicalGraph, build_schedule
+    from .interconnect import InterconnectModel
+
+    fabric = InterconnectModel(slice_size=8, dcn_cost=16.0)
+    hplan = plan_for(64, ppi=1,
+                     constraints=PlanConstraints(interconnect=fabric))
+    check(hplan.topology == "hierarchical" and hplan.slice_size == 8,
+          f"DCN-dominant world-64 plan did not pick the hierarchical "
+          f"topology (got {hplan.summary()})")
+    hs = build_schedule(HierarchicalGraph(64, slice_size=8))
+    hfind, hgap = verify_schedule(hs, "hierarchical-64", "<selftest>", 0)
+    check(hfind == [] and hgap > floor,
+          f"hierarchical world-64 schedule failed verification: "
+          f"{[f.rule for f in hfind]} gap={hgap}")
+    check(plan_for(64, ppi=1).topology != "hierarchical",
+          "uniform-fabric world-64 plan picked hierarchical (the DCN "
+          "weight should be what earns it the win)")
+    fabric_cands = score_candidates(64, (1,), interconnect=fabric)
+    hcand = next(c for c in fabric_cands if c.topology == "hierarchical")
+    flat = [c for c in fabric_cands
+            if c.slice_size is None and c.meets(floor)]
+    check(all(hcand.dcn_per_efold < c.dcn_per_efold for c in flat),
+          "hierarchical candidate does not minimize DCN volume per "
+          "consensus e-fold among floor-clearing candidates")
+
     if failures:
         for f in failures:
             print(f"planner selftest FAILED: {f}", file=sys.stderr)
@@ -105,6 +140,19 @@ def main(argv=None) -> int:
                     help="minimum acceptable rotation-cycle spectral gap")
     ap.add_argument("--topology", default=None,
                     help="score this forced topology instead of planning")
+    ap.add_argument("--slice-size", type=int, default=None,
+                    help="ranks per ICI slice (multi-slice fabric): "
+                         "intra-slice edges price at torus-hop ICI cost, "
+                         "cross-slice at the DCN weight, and the "
+                         "hierarchical candidate adopts this slice "
+                         "decomposition")
+    ap.add_argument("--dcn-cost", type=float, default=None,
+                    help="relative per-byte cost of one inter-slice DCN "
+                         "message (ICI hop = 1.0; default 16 when any "
+                         "fabric flag is set)")
+    ap.add_argument("--ici-cost", type=float, default=None,
+                    help="relative per-byte cost of one ICI torus hop "
+                         "(default 1.0)")
     ap.add_argument("--self-weighted", action="store_true",
                     help="co-optimize a SelfWeightedMixing alpha against "
                          "the chosen topology")
@@ -123,6 +171,8 @@ def main(argv=None) -> int:
 
     ppi = args.ppi if args.ppi else None
     try:
+        interconnect = make_interconnect(args.slice_size, args.dcn_cost,
+                                         args.ici_cost)
         if args.topology:
             from ..topology import TOPOLOGY_NAMES
             if args.topology not in TOPOLOGY_NAMES:
@@ -131,12 +181,14 @@ def main(argv=None) -> int:
             plan = check_topology(
                 args.world, TOPOLOGY_NAMES[args.topology],
                 ppi=ppi or 1, algorithm=args.algorithm, floor=args.floor,
-                self_weighted=args.self_weighted)
+                self_weighted=args.self_weighted,
+                interconnect=interconnect)
         else:
             plan = plan_for(args.world, ppi=ppi, algorithm=args.algorithm,
                             constraints=PlanConstraints(
                                 floor=args.floor,
-                                self_weighted=args.self_weighted))
+                                self_weighted=args.self_weighted,
+                                interconnect=interconnect))
     except ValueError as e:
         print(f"plan: error: {e}", file=sys.stderr)
         return 2
@@ -151,8 +203,8 @@ def main(argv=None) -> int:
         print()
         cands = score_candidates(
             args.world, (ppi,) if ppi else DEFAULT_PEER_COUNTS,
-            floor=args.floor)
-        _print_table(cands, args.floor)
+            floor=args.floor, interconnect=interconnect)
+        _print_table(cands, args.floor, priced=interconnect is not None)
     if args.json:
         payload = json.dumps(plan.to_dict(), indent=2, sort_keys=True)
         if args.json == "-":

@@ -31,16 +31,20 @@ checkpoint metadata), and emits the warnings.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import math
 
 from ..topology import TOPOLOGY_NAMES, topology_name
+from ..topology.hierarchical import HierarchicalGraph
 from ..topology.mixing import SelfWeightedMixing
 from .alpha import alpha_gap, optimize_alpha
+from .interconnect import InterconnectModel
 from .scorer import (
     DEFAULT_GAP_FLOOR,
     DEFAULT_PEER_COUNTS,
     evaluate_candidate,
+    instantiate_graph,
     score_candidates,
 )
 
@@ -70,6 +74,16 @@ class PlanConstraints:
     # allow the every-k exact-averaging fallback when nothing clears the
     # floor (False = plan the best candidate anyway and warn)
     allow_global_avg: bool = True
+    # fabric cost model pricing every candidate edge (torus ICI hops
+    # inside a slice, flat DCN weight across; None = uniform 1-D torus).
+    # A model with slice structure also fixes the hierarchical
+    # candidate's slice decomposition to the fabric's.
+    interconnect: InterconnectModel | None = None
+    # the run requests overlap mode / fault injection — synchronous
+    # flat-schedule features the hierarchical compiled round rejects at
+    # launch, so hierarchical candidates must not win the ranking
+    overlap: bool = False
+    faults: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,17 +102,26 @@ class Plan:
     gap: float               # measured rotation-cycle spectral gap
     floor: float
     num_phases: int
-    comm_cost: float         # messages per rank per consensus e-fold
+    comm_cost: float         # payloads per rank per consensus e-fold
     global_avg_every: int    # exact allreduce every k steps (0 = off)
     algorithm: str           # "sgp" | "dpsgd"
     auto: bool               # True = planner chose; False = user-forced
     rationale: str
     warnings: tuple[str, ...] = ()
     ranking: tuple[dict, ...] = ()  # top scored candidates, best first
+    slice_size: int | None = None   # hierarchical slice decomposition
+    interconnect: dict | None = None  # fabric model the plan was priced on
 
     @property
     def graph_class(self):
-        return TOPOLOGY_NAMES[self.topology]
+        cls = TOPOLOGY_NAMES[self.topology]
+        if self.slice_size and isinstance(cls, type) \
+                and issubclass(cls, HierarchicalGraph):
+            # the run layer instantiates graph_class(world, peers_per_itr=
+            # ppi); bind the planned slice decomposition so the compiled
+            # schedule matches the one that was scored and stamped
+            return functools.partial(cls, slice_size=self.slice_size)
+        return cls
 
     def mixing_strategy(self):
         """Instantiate the plan's mixing strategy (None = uniform, the
@@ -204,13 +227,28 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
     peer_counts = ((int(ppi),) if ppi else
                    cons.peer_counts or DEFAULT_PEER_COUNTS)
     cands = score_candidates(world, peer_counts, floor=cons.floor,
-                             allowed=cons.allowed)
+                             allowed=cons.allowed,
+                             interconnect=cons.interconnect)
+    if algorithm == "dpsgd":
+        # D-PSGD mixes doubly-stochastically; an irregular schedule (the
+        # hierarchical two-level graph) would be rejected by the
+        # algorithm at launch, so it must not win the ranking
+        cands = [c for c in cands if c.regular]
+    if cons.overlap or cons.faults:
+        # PushSumGossip rejects hierarchical schedules under overlap and
+        # fault injection (the grouped psum has no split/per-edge mask),
+        # so the planner must not recommend one to such a run
+        cands = [c for c in cands if not c.slice_size]
     if not cands:
         raise ValueError(
             f"no registered topology supports world={world} with "
             f"peers_per_itr in {peer_counts}"
             + (f" within allowed={sorted(cons.allowed)}" if cons.allowed
-               else ""))
+               else "")
+            + (" for algorithm=dpsgd (regular schedules only)"
+               if algorithm == "dpsgd" else "")
+            + (" compatible with overlap/fault injection (flat "
+               "schedules only)" if cons.overlap or cons.faults else ""))
     best = cands[0]
     warnings: list[str] = []
 
@@ -218,12 +256,20 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
     rationale = (f"{best.topology} (ppi {best.ppi}) ranked best of "
                  f"{len(cands)} candidates: gap {best.gap:.4f}, "
                  f"{best.num_phases} phase(s)/cycle")
+    if best.slice_size:
+        rationale += (f", {world // best.slice_size} slices of "
+                      f"{best.slice_size}")
     if math.isfinite(best.comm_cost):
-        rationale += (f", ~{best.comm_cost:.1f} messages/rank per "
+        rationale += (f", ~{best.comm_cost:.1f} payloads/rank per "
                       "consensus e-fold")
     else:
         rationale += " (cycle does not contract)"
+    if cons.interconnect is not None and math.isfinite(best.priced_cost):
+        rationale += (f" (priced {best.priced_cost:.1f} on the fabric "
+                      f"model: ICI {best.ici_per_efold:.1f} + DCN "
+                      f"{best.dcn_per_efold:.1f})")
     if cons.self_weighted:
+        # Candidate.graph_class binds the scored slice decomposition
         graph = best.graph_class(world, peers_per_itr=best.ppi)
         mixing, alpha, gap, frag, sw_warn = _apply_self_weighted(
             best, graph, cons.self_weighted)
@@ -261,14 +307,19 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
                 num_phases=best.num_phases, comm_cost=best.comm_cost,
                 global_avg_every=gae, algorithm=algorithm,
                 auto=True, rationale=rationale, warnings=tuple(warnings),
-                ranking=tuple(c.to_dict() for c in cands[:8]))
+                ranking=tuple(c.to_dict() for c in cands[:8]),
+                slice_size=best.slice_size,
+                interconnect=(cons.interconnect.to_dict()
+                              if cons.interconnect else None))
 
 
 def check_topology(world: int, graph_class, ppi: int = 1,
                    algorithm: str = "sgp",
                    floor: float = DEFAULT_GAP_FLOOR,
                    self_weighted: bool | float = False,
-                   global_avg_every: int | None = None) -> Plan:
+                   global_avg_every: int | None = None,
+                   interconnect: InterconnectModel | None = None,
+                   overlap: bool = False, faults: bool = False) -> Plan:
     """Score a user-forced topology and warn if it is below the floor.
 
     The warning is structured (one JSON payload) and names the measured
@@ -284,15 +335,28 @@ def check_topology(world: int, graph_class, ppi: int = 1,
                     alpha=None, gap=1.0, floor=floor, num_phases=1,
                     comm_cost=0.0, global_avg_every=0, algorithm=algorithm,
                     auto=False, rationale="world < 2: gossip is a no-op")
-    cand = evaluate_candidate(graph_class, world, ppi)
+    cand = evaluate_candidate(graph_class, world, ppi,
+                              interconnect=interconnect)
     if cand is None:
         raise ValueError(f"{name} does not support world={world} with "
                          f"peers_per_itr={ppi}")
+    if algorithm == "dpsgd" and not cand.regular:
+        raise ValueError(
+            f"dpsgd requires a regular (doubly-stochastic) schedule; "
+            f"{name} is irregular — use push-sum (sgp) or a flat topology")
+    if cand.slice_size and (overlap or faults):
+        raise ValueError(
+            f"{name} is a two-level hierarchical schedule; overlap mode "
+            "and fault injection are flat-schedule features (the grouped "
+            "psum has no split/per-edge mask) — use a flat topology")
     gap, mixing, alpha = cand.gap, "uniform", None
     rationale = f"user-forced {name} (ppi {ppi}): gap {gap:.4f}"
+    if cand.slice_size:
+        rationale += (f", {world // cand.slice_size} slices of "
+                      f"{cand.slice_size}")
     warnings: list[str] = []
     if self_weighted:
-        graph = graph_class(world, peers_per_itr=ppi)
+        graph = instantiate_graph(graph_class, world, ppi, interconnect)
         mixing, alpha, gap, frag, sw_warn = _apply_self_weighted(
             cand, graph, self_weighted)
         rationale += "; " + frag
@@ -301,7 +365,9 @@ def check_topology(world: int, graph_class, ppi: int = 1,
     gae = 0
     if gap < floor:
         alt = plan_for(world, ppi=ppi, algorithm=algorithm,
-                       constraints=PlanConstraints(floor=floor))
+                       constraints=PlanConstraints(
+                           floor=floor, interconnect=interconnect,
+                           overlap=overlap, faults=faults))
         gae = (averaging_period(gap, floor) if global_avg_every is None
                else max(0, global_avg_every))
         payload = {
@@ -329,7 +395,10 @@ def check_topology(world: int, graph_class, ppi: int = 1,
                 alpha=alpha, gap=gap, floor=floor,
                 num_phases=cand.num_phases, comm_cost=cand.comm_cost,
                 global_avg_every=gae, algorithm=algorithm,
-                auto=False, rationale=rationale, warnings=tuple(warnings))
+                auto=False, rationale=rationale, warnings=tuple(warnings),
+                slice_size=cand.slice_size,
+                interconnect=(interconnect.to_dict()
+                              if interconnect else None))
 
 
 def resolve_topology(world: int, *, ppi: int = 1,
@@ -339,6 +408,8 @@ def resolve_topology(world: int, *, ppi: int = 1,
                      algorithm: str = "sgp",
                      self_weighted: bool | float = False,
                      global_avg_every: int | None = None,
+                     interconnect: InterconnectModel | None = None,
+                     overlap: bool = False, faults: bool = False,
                      log=None, registry=None) -> Plan:
     """Run-layer entry point: resolve ``--topology``/``--graph_type`` into
     a :class:`Plan`, log it, and emit any warnings.
@@ -351,6 +422,12 @@ def resolve_topology(world: int, *, ppi: int = 1,
       global_avg_every: user override for the averaging period (None =
         the policy decides; 0 = explicitly off, warned below the floor;
         k = every-k averaging regardless of the gap).
+      interconnect: fabric cost model from the CLI's --slice_size /
+        --dcn_cost / --ici_cost flags (None = uniform fabric); candidate
+        pricing and the hierarchical slice decomposition follow it.
+      overlap / faults: the run requests overlap mode / fault injection;
+        hierarchical schedules reject both at launch, so auto mode
+        excludes them from the ranking and forced mode fails fast.
       log: optional logger; the plan is logged as one JSON line and each
         warning loudly via ``log.warning``.
       registry: optional telemetry registry; when set, the plan publishes
@@ -360,7 +437,9 @@ def resolve_topology(world: int, *, ppi: int = 1,
     if topology == "auto":
         plan = plan_for(world, ppi=ppi, algorithm=algorithm,
                         constraints=PlanConstraints(
-                            floor=floor, self_weighted=self_weighted),
+                            floor=floor, self_weighted=self_weighted,
+                            interconnect=interconnect,
+                            overlap=overlap, faults=faults),
                         global_avg_every=global_avg_every)
     else:
         cls = TOPOLOGY_NAMES[topology] if topology else graph_class
@@ -369,7 +448,9 @@ def resolve_topology(world: int, *, ppi: int = 1,
                              "graph_class")
         plan = check_topology(world, cls, ppi=ppi, algorithm=algorithm,
                               floor=floor, self_weighted=self_weighted,
-                              global_avg_every=global_avg_every)
+                              global_avg_every=global_avg_every,
+                              interconnect=interconnect,
+                              overlap=overlap, faults=faults)
     if registry is not None:
         # info like the legacy line (plan *warnings* go via log below)
         registry.emit("plan", plan.to_dict(), severity="info")

@@ -17,20 +17,27 @@ This module turns that observation into a ranking:
   each round sending ``peers_per_itr`` messages per rank.  Exact-consensus
   cycles (gap 1.0, e.g. DynamicBipartiteLinearGraph at even worlds) cost
   exactly one cycle.
-* **hop cost** — the same model with each message weighted by its ring
-  hop distance on the device mesh instead of counting all messages
-  equally: gossip ranks are laid out along a 1-D mesh axis whose
-  neighbors ride the shortest ICI path, so a message to rank ``±d`` costs
-  ``min(d, n−d)`` link traversals (the wrap-around torus link closes the
-  ring).  Two isomorphic graphs with identical spectral gaps can differ
-  several-fold here — a stride-3 "ring" mixes exactly like the neighbor
-  ring but pays 3 hops per message.
+* **priced cost** — the same model with each message weighted by the
+  :class:`~.interconnect.InterconnectModel`: torus hop distance × ICI
+  weight inside a slice, a flat (and typically much larger) DCN weight
+  across slices, and hierarchical schedules' intra-slice exact averages
+  priced as grouped ring-allreduces (``2·(s−1)/s`` payloads at one ICI
+  hop).  This is what lets a two-level
+  :class:`~..topology.hierarchical.HierarchicalGraph` — sparse on DCN,
+  exact on ICI — outrank flat graphs exactly when the fabric says DCN
+  dominates, and lose to them on a uniform fabric.
+* **hop cost** — the priced cost evaluated on the :data:`UNIFORM`
+  fabric (one 1-D torus, every hop equal): a message to rank ``±d``
+  costs ``min(d, n−d)`` link traversals.  Two isomorphic graphs with
+  identical spectral gaps can differ several-fold here — a stride-3
+  "ring" mixes exactly like the neighbor ring but pays 3 hops per
+  message.
 
 Ranking prefers candidates that clear the gap floor, then the cheapest
-*hop-weighted* consensus, then the largest gap — so a slow-but-connected
-ring never outranks an exponential graph, among perfect mixers the one
-with the shortest cycle wins, and among equal mixers the one hugging the
-physical interconnect wins.
+*priced* consensus under the active interconnect model, then the largest
+gap — so a slow-but-connected ring never outranks an exponential graph,
+among perfect mixers the one with the shortest cycle wins, and among
+equal mixers the one hugging the physical interconnect wins.
 
 Everything here is plain numpy over small ``world × world`` matrices; the
 full candidate grid for a 64-rank pod scores in well under a second on one
@@ -40,23 +47,29 @@ CPU core, which is what makes launch-time planning free.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 
 # shared with the verifier (stable exports) so the planner and the CI
 # gate measure gaps identically and skip the exact same cells
 from ..analysis import is_unsupported_config, spectral_gap
 from ..topology import TOPOLOGY_NAMES, build_schedule, topology_name
+from ..topology.hierarchical import HierarchicalGraph
 from ..topology.mixing import MixingStrategy, SelfWeightedMixing, UniformMixing
+from .interconnect import UNIFORM, InterconnectModel
 
 __all__ = [
     "Candidate",
     "DEFAULT_GAP_FLOOR",
     "DEFAULT_PEER_COUNTS",
     "consensus_cost",
+    "cycle_cost",
     "evaluate_candidate",
     "hops_per_round",
+    "instantiate_graph",
     "ring_hop_distance",
     "score_candidates",
+    "wire_per_round",
 ]
 
 # gap below which a topology is considered effectively non-mixing at the
@@ -77,14 +90,26 @@ class Candidate:
     mixing: str              # "uniform" or "self-weighted(<alpha>)"
     alpha: float | None      # scalar SelfWeightedMixing alpha, if any
     gap: float               # rotation-cycle spectral gap 1 - |λ₂|
-    num_phases: int          # rotation phases per cycle
+    num_phases: int          # gossip rounds per rotation cycle
     rounds_per_efold: float  # gossip rounds per e-fold of consensus error
-    comm_cost: float         # messages per rank per e-fold (rounds × ppi)
-    hop_cost: float = math.inf  # ring-hop-weighted messages per e-fold
+    comm_cost: float         # payloads per rank per e-fold (wire volume)
+    hop_cost: float = math.inf    # priced cost on the UNIFORM fabric
+    priced_cost: float = math.inf  # priced cost, active interconnect model
+    ici_per_efold: float = math.inf  # ICI share of priced_cost
+    dcn_per_efold: float = 0.0       # DCN share of priced_cost
+    slice_size: int | None = None    # hierarchical slice decomposition
+    regular: bool = True             # D-PSGD needs doubly-stochastic mixing
 
     @property
     def graph_class(self):
-        return TOPOLOGY_NAMES[self.topology]
+        """Constructor for the scored topology.  A hierarchical candidate
+        binds the slice decomposition it was scored with (like
+        ``Plan.graph_class``) so ``graph_class(world, peers_per_itr=ppi)``
+        rebuilds exactly the schedule behind this candidate's numbers."""
+        cls = TOPOLOGY_NAMES[self.topology]
+        if self.slice_size:
+            return functools.partial(cls, slice_size=self.slice_size)
+        return cls
 
     def meets(self, floor: float) -> bool:
         return self.gap >= floor
@@ -92,12 +117,10 @@ class Candidate:
     def to_dict(self) -> dict:
         """JSON-safe summary (checkpoint metadata / report artifacts)."""
         d = dataclasses.asdict(self)
-        d["comm_cost"] = round(self.comm_cost, 3) \
-            if math.isfinite(self.comm_cost) else None
-        d["hop_cost"] = round(self.hop_cost, 3) \
-            if math.isfinite(self.hop_cost) else None
-        d["rounds_per_efold"] = round(self.rounds_per_efold, 3) \
-            if math.isfinite(self.rounds_per_efold) else None
+        for k in ("comm_cost", "hop_cost", "priced_cost", "ici_per_efold",
+                  "dcn_per_efold", "rounds_per_efold"):
+            v = getattr(self, k)
+            d[k] = round(v, 3) if math.isfinite(v) else None
         return d
 
 
@@ -143,23 +166,114 @@ def hops_per_round(schedule) -> float:
     return total / (schedule.num_phases * n)
 
 
+def _rounds_per_cycle(schedule) -> int:
+    """Compiled gossip rounds in one rotation cycle (a hierarchical
+    round spans two table phases)."""
+    return getattr(schedule, "rounds_per_cycle", schedule.num_phases)
+
+
+def wire_per_round(schedule) -> float:
+    """Payload-equivalents each rank puts on the wire per gossip round.
+
+    Flat schedules send ``peers_per_itr`` full payloads.  Hierarchical
+    rounds send the delegate messages (``num_slices × dcn_fanout ×
+    inter_ppi / world`` per rank on average) plus the intra-slice grouped
+    allreduce (``2·(s−1)/s`` payloads per rank, the bandwidth-optimal
+    ring cost).
+    """
+    if getattr(schedule, "phase_kinds", None) is None:
+        return float(schedule.peers_per_itr)
+    s = schedule.slice_size
+    inter = (schedule.num_slices * schedule.dcn_fanout
+             * schedule.inter_ppi / schedule.world_size)
+    return inter + 2.0 * (s - 1) / s
+
+
+def cycle_cost(schedule, model: InterconnectModel
+               ) -> tuple[float, float]:
+    """Per-rank mean priced cost of one full rotation cycle.
+
+    Returns ``(ici, dcn)`` in payload-equivalents × link weight.  Every
+    non-zero-weight edge in the tables is one message priced by
+    :meth:`InterconnectModel.edge_cost`.  When the model declares slice
+    structure, hierarchical intra phases are priced as what they compile
+    to on such a fabric — a grouped ring-allreduce inside each slice,
+    ``2·(s−1)/s`` payloads per rank at one ICI hop.  On a model with no
+    slice structure there is no ICI domain to fuse the group collective
+    into, so the schedule is priced conservatively as written (its
+    ``s−1`` permutation sends at torus distance) — which is why flat
+    graphs win the ranking on a uniform fabric and hierarchical wins
+    only when the fabric says DCN dominates.
+    """
+    n = schedule.world_size
+    kinds = getattr(schedule, "phase_kinds", None)
+    ici = dcn = 0.0
+    for p in range(schedule.num_phases):
+        if kinds is not None and kinds[p] == "intra" \
+                and model.slice_size:
+            s = schedule.slice_size
+            ici += model.ici_cost * 2.0 * (s - 1) / s
+            continue
+        perms = schedule.perms[p]
+        weights = schedule.edge_weights[p]
+        for i in range(schedule.peers_per_itr):
+            for src in range(n):
+                if weights[i, src] <= 0.0:
+                    continue
+                dst = int(perms[i, src])
+                if dst == src:
+                    continue
+                cost = model.edge_cost(src, dst, n) / n
+                if model.is_cross_slice(src, dst):
+                    dcn += cost
+                else:
+                    ici += cost
+    return ici, dcn
+
+
+def instantiate_graph(graph_class, world: int, ppi: int,
+                      interconnect: InterconnectModel | None = None):
+    """Build a topology instance, aligning a hierarchical graph's slice
+    decomposition with the fabric's when the interconnect declares one."""
+    if isinstance(graph_class, type) \
+            and issubclass(graph_class, HierarchicalGraph) \
+            and interconnect is not None and interconnect.slice_size:
+        return graph_class(world, peers_per_itr=ppi,
+                           slice_size=interconnect.slice_size)
+    return graph_class(world, peers_per_itr=ppi)
+
+
 def evaluate_candidate(graph_class, world: int, ppi: int,
-                       mixing: MixingStrategy | None = None
+                       mixing: MixingStrategy | None = None,
+                       interconnect: InterconnectModel | None = None
                        ) -> Candidate | None:
     """Score one cell; ``None`` when the generator refuses the
     configuration (odd world for a bipartite graph, ppi beyond the phone
-    book, ...)."""
+    book, ...).  ``interconnect`` prices the edges (None = uniform
+    fabric, the original ring-hop model)."""
+    model = interconnect or UNIFORM
     try:
-        graph = graph_class(world, peers_per_itr=ppi)
+        graph = instantiate_graph(graph_class, world, ppi, model)
         schedule = build_schedule(graph, mixing)
     except ValueError as e:
         if is_unsupported_config(e):
             return None
         raise
     gap = spectral_gap(schedule)
-    rounds, cost = consensus_cost(gap, schedule.num_phases, ppi)
-    hop_cost = rounds * hops_per_round(schedule) \
-        if math.isfinite(rounds) else math.inf
+    rpc = _rounds_per_cycle(schedule)
+    rounds, _ = consensus_cost(gap, rpc, ppi)
+    if math.isfinite(rounds):
+        cycles = rounds / rpc
+        comm = rounds * wire_per_round(schedule)
+        uniform_costs = cycle_cost(schedule, UNIFORM)
+        hop_cost = cycles * sum(uniform_costs)
+        ici_c, dcn_c = (uniform_costs if model is UNIFORM
+                        else cycle_cost(schedule, model))
+        ici_e, dcn_e = cycles * ici_c, cycles * dcn_c
+        priced = ici_e + dcn_e
+    else:
+        comm = hop_cost = priced = ici_e = math.inf
+        dcn_e = 0.0
     alpha = None
     mix_name = "uniform"
     if isinstance(mixing, SelfWeightedMixing):
@@ -176,15 +290,20 @@ def evaluate_candidate(graph_class, world: int, ppi: int,
         name = graph_class.__name__
     return Candidate(topology=name, world=world,
                      ppi=ppi, mixing=mix_name, alpha=alpha, gap=gap,
-                     num_phases=schedule.num_phases,
-                     rounds_per_efold=rounds, comm_cost=cost,
-                     hop_cost=hop_cost)
+                     num_phases=rpc,
+                     rounds_per_efold=rounds, comm_cost=comm,
+                     hop_cost=hop_cost, priced_cost=priced,
+                     ici_per_efold=ici_e, dcn_per_efold=dcn_e,
+                     slice_size=getattr(schedule, "slice_size", None),
+                     regular=bool(schedule.regular))
 
 
 def score_candidates(world: int,
                      peer_counts=DEFAULT_PEER_COUNTS,
                      floor: float = DEFAULT_GAP_FLOOR,
-                     allowed=None) -> list[Candidate]:
+                     allowed=None,
+                     interconnect: InterconnectModel | None = None
+                     ) -> list[Candidate]:
     """Rank every supported (topology × peers_per_itr) cell for ``world``
     under uniform mixing.
 
@@ -194,9 +313,11 @@ def score_candidates(world: int,
       floor: the gap floor used for ranking (floor-clearing candidates
         always outrank the rest).
       allowed: optional iterable of topology names restricting the search.
+      interconnect: fabric cost model pricing every edge (None = the
+        uniform 1-D torus — the original ring-hop ranking).
 
     Returns candidates sorted best-first: clears-the-floor, then cheapest
-    hop-weighted consensus (mesh-distance comm model), then largest gap,
+    priced consensus under the interconnect model, then largest gap,
     then (name, ppi) for determinism.
     """
     names = sorted(TOPOLOGY_NAMES) if allowed is None else sorted(allowed)
@@ -208,9 +329,10 @@ def score_candidates(world: int,
     for name in names:
         for ppi in peer_counts:
             c = evaluate_candidate(TOPOLOGY_NAMES[name], world, ppi,
-                                   UniformMixing())
+                                   UniformMixing(),
+                                   interconnect=interconnect)
             if c is not None:
                 cands.append(c)
-    cands.sort(key=lambda c: (not c.meets(floor), c.hop_cost, -c.gap,
+    cands.sort(key=lambda c: (not c.meets(floor), c.priced_cost, -c.gap,
                               c.topology, c.ppi))
     return cands

@@ -108,11 +108,20 @@ class RecoveryPolicy:
                  topology: str | None = None,
                  residual_floor: float = 0.01,
                  cooldown_steps: int = 10,
-                 max_recoveries: int = 0, log=None, registry=None):
+                 max_recoveries: int = 0, log=None, registry=None,
+                 interconnect=None, faults: bool = False):
         self.world = world
         self.ppi = ppi
         self.algorithm = algorithm
         self.topology = topology          # current graph, for the diff
+        # fabric model the run was planned on (planner.InterconnectModel
+        # or None): re-plan suggestions must price edges on the same
+        # fabric or they would suggest a flat graph on a DCN-dominant pod
+        self.interconnect = interconnect
+        # the run injects faults: re-plan suggestions must exclude
+        # topologies the relaunch would reject (hierarchical schedules
+        # refuse per-edge fault masks)
+        self.faults = faults
         self.residual_floor = residual_floor
         self.cooldown_steps = max(0, cooldown_steps)
         self.max_recoveries = max_recoveries
@@ -132,9 +141,12 @@ class RecoveryPolicy:
         a JSON-safe suggestion {topology, gap, global_avg_every, switch}.
         ``switch`` is True when the suggestion differs from the running
         topology — the relaunch hint."""
-        from ..planner import plan_for
+        from ..planner import PlanConstraints, plan_for
 
-        plan = plan_for(self.world, ppi=self.ppi, algorithm=self.algorithm)
+        plan = plan_for(self.world, ppi=self.ppi, algorithm=self.algorithm,
+                        constraints=PlanConstraints(
+                            interconnect=self.interconnect,
+                            faults=self.faults))
         return {"topology": plan.topology, "ppi": plan.ppi,
                 "gap": round(plan.gap, 6),
                 "global_avg_every": plan.global_avg_every,

@@ -53,6 +53,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "the planner decides (enabled when no gossip "
                         "graph clears the gap floor), 0 = explicitly "
                         "off, k = force every-k averaging")
+    p.add_argument("--slice_size", default=None, type=int,
+                   help="gossip replicas per ICI slice on a multi-slice "
+                        "pod: the planner prices intra-slice edges at "
+                        "torus-hop ICI cost and cross-slice edges at the "
+                        "DCN weight, and a planned/forced 'hierarchical' "
+                        "topology adopts this slice decomposition; "
+                        "unset = uniform fabric")
+    p.add_argument("--dcn_cost", default=None, type=float,
+                   help="relative per-byte cost of one inter-slice (DCN) "
+                        "message (ICI hop = 1.0; default 16 when any "
+                        "fabric flag is set)")
+    p.add_argument("--ici_cost", default=None, type=float,
+                   help="relative per-byte cost of one intra-slice ICI "
+                        "torus hop (default 1.0)")
     p.add_argument("--mixing_alpha", default=None, type=str,
                    help="SelfWeightedMixing self-mass: 'auto' co-"
                         "optimizes alpha against the chosen topology "
@@ -301,9 +315,14 @@ def main(argv=None):
         raise SystemExit("--mixing_alpha needs push-sum gossip: AllReduce "
                          "doesn't mix, and D-PSGD requires a regular "
                          "(doubly-stochastic) schedule")
-    if args.mixing_alpha is not None and (sb(args.bilat) or dp < 2):
-        raise SystemExit("--topology auto / --mixing_alpha plan "
-                         "gossip schedules; they do not apply to "
+    fabric_flags = (args.slice_size is not None
+                    or args.dcn_cost is not None
+                    or args.ici_cost is not None)
+    if (args.mixing_alpha is not None or fabric_flags) \
+            and (sb(args.bilat) or sb(args.all_reduce) or dp < 2):
+        raise SystemExit("--topology auto / --mixing_alpha / fabric "
+                         "flags (--slice_size/--dcn_cost/--ici_cost) "
+                         "plan gossip schedules; they do not apply to "
                          "all_reduce/bilateral modes or a "
                          "single-rank world")
     if args.inject_faults:
@@ -353,9 +372,12 @@ def main(argv=None):
     # the launch subsequently fails): the gossip world for the LM is the
     # data-parallel replica count, not raw devices
     plan = None
+    interconnect = None
     if not sb(args.all_reduce) and not sb(args.bilat) and dp > 1:
-        from ..planner import resolve_topology
+        from ..planner import make_interconnect, resolve_topology
 
+        interconnect = make_interconnect(args.slice_size, args.dcn_cost,
+                                         args.ici_cost)
         plan = resolve_topology(
             dp, ppi=args.peers_per_itr, topology=args.topology,
             graph_class=GRAPH_TOPOLOGIES[args.graph_type],
@@ -364,6 +386,8 @@ def main(argv=None):
             self_weighted=(True if args.mixing_alpha == "auto"
                            else (args.mixing_alpha or False)),
             global_avg_every=args.global_avg_every,  # None = policy
+            interconnect=interconnect,
+            overlap=sb(args.overlap), faults=bool(args.inject_faults),
             log=log, registry=rt.registry)
     elif args.topology is not None and (sb(args.all_reduce)
                                         or sb(args.bilat)):
@@ -671,7 +695,8 @@ def main(argv=None):
                 alg.schedule, wire, exact_bytes=exact,
                 gossip_every=alg.gossip_every,
                 global_avg_every=alg.global_avg_every,
-                faults=alg.faults, ps_weight=sb(args.push_sum))
+                faults=alg.faults, ps_weight=sb(args.push_sum),
+                interconnect=interconnect)
         rt.attach_comm(comm_model)
     if rt.enabled:
         rt.registry.emit("run_meta", {
@@ -866,7 +891,8 @@ def main(argv=None):
                 topology=plan.topology if plan is not None else None,
                 residual_floor=args.residual_floor,
                 cooldown_steps=args.health_every, log=log,
-                registry=rt.registry)
+                registry=rt.registry, interconnect=interconnect,
+                faults=bool(args.inject_faults))
             recovery = make_recovery_fn(alg, mesh)
 
     loss_meter = Meter(ptag="Loss")

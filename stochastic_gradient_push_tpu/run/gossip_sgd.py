@@ -89,6 +89,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "periodic averaging when no gossip graph clears "
                         "the gap floor), 0 = explicitly off even below "
                         "the floor, k = force every-k averaging")
+    p.add_argument("--slice_size", default=None, type=int,
+                   help="ranks per ICI slice (contiguous blocks) on a "
+                        "multi-slice pod: the planner prices intra-slice "
+                        "edges at torus-hop ICI cost and cross-slice "
+                        "edges at the DCN weight, and a planned/forced "
+                        "'hierarchical' topology adopts this slice "
+                        "decomposition; unset = uniform fabric")
+    p.add_argument("--dcn_cost", default=None, type=float,
+                   help="relative per-byte cost of one inter-slice (DCN) "
+                        "message (ICI hop = 1.0; default 16 when any "
+                        "fabric flag is set); calibrate with bench.py "
+                        "--gossip-vs-ar on real slices")
+    p.add_argument("--ici_cost", default=None, type=float,
+                   help="relative per-byte cost of one intra-slice ICI "
+                        "torus hop (default 1.0)")
     p.add_argument("--mixing_alpha", default=None, type=str,
                    help="SelfWeightedMixing self-mass: 'auto' co-"
                         "optimizes alpha against the chosen topology "
@@ -358,15 +373,23 @@ def _resolve_plan(cfg, args, gossip_world: int, log, registry=None):
     when one exists) and stamped into ``cfg.plan`` (and from there into
     checkpoint metadata).
     """
+    fabric_flags = (args.slice_size is not None
+                    or args.dcn_cost is not None
+                    or args.ici_cost is not None)
     if cfg.all_reduce or cfg.bilat or cfg.bilat_async or gossip_world < 2:
-        if args.topology == "auto" or args.mixing_alpha is not None:
-            raise SystemExit("--topology auto / --mixing_alpha plan "
-                             "gossip schedules; they do not apply to "
-                             "all_reduce/bilateral modes or a "
+        if args.topology == "auto" or args.mixing_alpha is not None \
+                or fabric_flags:
+            raise SystemExit("--topology auto / --mixing_alpha / fabric "
+                             "flags (--slice_size/--dcn_cost/--ici_cost) "
+                             "plan gossip schedules; they do not apply "
+                             "to all_reduce/bilateral modes or a "
                              "single-rank world")
         return
-    from ..planner import resolve_topology
+    from ..planner import make_interconnect, resolve_topology
     from ..train.lr import ppi_at_epoch
+
+    interconnect = make_interconnect(args.slice_size, args.dcn_cost,
+                                     args.ici_cost)
 
     # plan for the epoch-0 peers_per_itr (a ppi schedule can change it
     # later; the stamped plan records which value was planned for)
@@ -380,6 +403,8 @@ def _resolve_plan(cfg, args, gossip_world: int, log, registry=None):
         self_weighted=(True if args.mixing_alpha == "auto"
                        else (args.mixing_alpha or False)),
         global_avg_every=args.global_avg_every,  # None = policy decides
+        interconnect=interconnect,
+        overlap=cfg.overlap, faults=bool(cfg.inject_faults),
         log=log, registry=registry)
     cfg.graph_class = plan.graph_class
     if plan.alpha is not None:

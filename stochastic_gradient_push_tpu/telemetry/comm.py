@@ -18,6 +18,14 @@ measured step time.  This module does that conversion:
     implementation always executes every ppermute edge (faults only
     zero the mixing weights), so wire bytes are fault-independent; the
     ``+ 4`` is the push-sum weight scalar riding each message.
+  - *ICI vs DCN lanes*: the wire split by link class.  Every gossip
+    edge is classified by the fabric's slice decomposition (the
+    planner's ``InterconnectModel.slice_size``, or the schedule's own
+    slices for a hierarchical run): same slice → ``gossip_ici``, cross
+    slice → ``gossip_dcn``.  Without slice structure everything is ICI,
+    so flat single-slice runs are unchanged.  Hierarchical rounds price
+    the delegate messages per edge and the intra-slice grouped psum as
+    a ring allreduce inside the slice, ``2·(s−1)/s × payload`` of ICI.
   - *gossip delivered*: wire bytes × the fault plan's surviving-edge
     fraction at that tick — what actually lands in the mixing sum.
   - *hop-weighted*: wire bytes × the phase's mean ring-hop distance
@@ -26,7 +34,8 @@ measured step time.  This module does that conversion:
     measured step time.
   - *exact averages* (scheduled ``global_avg_every``, reactive
     recovery, or AllReduce-every-step mode): ring-allreduce cost,
-    ``2·(n−1)/n × payload`` per rank.
+    ``2·(n−1)/n × payload`` per rank.  These lanes are whole-fabric
+    collectives and are not link-classified.
 
 * :class:`CommAccountant` — the running tally the train loop feeds
   (``on_step`` per optimizer step, ``on_recovery`` per reactive
@@ -54,8 +63,10 @@ __all__ = ["CommModel", "CommAccountant", "tree_payload_bytes",
 # the push-sum weight scalar that rides along with every gossip payload
 PS_WEIGHT_BYTES = 4
 
-# byte categories every snapshot reports (zero-filled when inactive)
+# byte categories every snapshot reports (zero-filled when inactive);
+# gossip_ici + gossip_dcn == gossip_wire (the wire split by link class)
 COMM_CATEGORIES = ("gossip_wire", "gossip_delivered", "gossip_hop_bytes",
+                   "gossip_ici", "gossip_dcn",
                    "global_avg", "recovery", "allreduce")
 
 
@@ -112,6 +123,17 @@ class CommModel:
     # unwieldy; store the per-row delivered fraction instead
     keep_fraction_rows: tuple[float, ...] = ()
     keep_horizon: int = 0
+    # link-class lanes: fabric slice decomposition classifying each edge
+    # (None = one slice, everything ICI) and the resulting per-phase
+    # per-rank byte splits — precomputed at construction; for a
+    # hierarchical schedule a "phase" is one compiled round (delegate
+    # messages + intra-slice grouped allreduce)
+    slice_size: int | None = None
+    hier: bool = False
+    wire_bytes_per_phase: tuple[int, ...] = ()
+    ici_bytes_per_phase: tuple[int, ...] = ()
+    dcn_bytes_per_phase: tuple[int, ...] = ()
+    hop_bytes_per_phase: tuple[int, ...] = ()
 
     # -- constructors ------------------------------------------------------
 
@@ -119,22 +141,93 @@ class CommModel:
     def from_schedule(cls, schedule, payload_bytes: int,
                       exact_bytes: int | None = None,
                       gossip_every: int = 1, global_avg_every: int = 0,
-                      faults=None, ps_weight: bool = True) -> "CommModel":
+                      faults=None, ps_weight: bool = True,
+                      interconnect=None) -> "CommModel":
         """Model a push-sum/D-PSGD run over ``schedule``.
 
         ``faults`` is an optional ``resilience.FaultMasks``; its keep
         table yields the delivered fraction per tick row.  ``ps_weight``
         False drops the per-message weight scalar (D-PSGD).
+        ``interconnect`` (a planner ``InterconnectModel``) supplies the
+        fabric slice decomposition for the ICI/DCN lane split; without
+        one, a hierarchical schedule's own slices classify and flat
+        schedules stay single-lane ICI.
         """
         n = schedule.world_size
+        payload = int(payload_bytes)
+        exact = int(exact_bytes if exact_bytes is not None
+                    else payload_bytes)
+        overhead = PS_WEIGHT_BYTES if ps_weight else 0
+        msg = payload + overhead
+        fabric = getattr(interconnect, "slice_size", None) \
+            or getattr(schedule, "slice_size", None)
+
+        def classify(perms, weights, phases, ppi):
+            """Per-phase (cross_msgs, same_msgs, hop_sum) over real
+            edges (zero-weight padding and loopbacks excluded)."""
+            rows = []
+            for p in range(phases):
+                cross = same = 0
+                hop_sum = 0.0
+                for i in range(ppi):
+                    for src in range(n):
+                        if weights[p, i, src] <= 0.0:
+                            continue
+                        dst = int(perms[p, i, src])
+                        if dst == src:
+                            continue
+                        if fabric and src // fabric != dst // fabric:
+                            cross += 1
+                        else:
+                            same += 1
+                        hop_sum += _ring_hop(src, dst, n)
+                rows.append((cross, same, hop_sum))
+            return rows
+
+        if getattr(schedule, "phase_kinds", None) is not None:
+            # hierarchical: one model phase per compiled round
+            if faults is not None:
+                raise ValueError("fault pricing is not supported on "
+                                 "hierarchical schedules")
+            inter = schedule.inter_schedule
+            s = schedule.slice_size
+            intra_bytes = int(round(2.0 * (s - 1) / s * exact))
+            wire_l, ici_l, dcn_l, hop_l = [], [], [], []
+            for cross, same, hop_sum in classify(
+                    inter.perms, inter.edge_weights,
+                    schedule.rounds_per_cycle, inter.peers_per_itr):
+                dcn = int(round(cross * msg / n))
+                ici = int(round(same * msg / n)) + intra_bytes
+                wire_l.append(dcn + ici)
+                ici_l.append(ici)
+                dcn_l.append(dcn)
+                # the grouped psum is nearest-neighbour inside the slice:
+                # one hop per byte; delegate messages at ring distance
+                hop_l.append(int(round(hop_sum * msg / n)) + intra_bytes)
+            return cls(mode="gossip", world=n, ppi=schedule.inter_ppi,
+                       num_phases=schedule.rounds_per_cycle,
+                       payload_bytes=payload, exact_bytes=exact,
+                       msg_overhead_bytes=overhead,
+                       gossip_every=max(1, int(gossip_every)),
+                       global_avg_every=max(0, int(global_avg_every)),
+                       slice_size=fabric, hier=True,
+                       wire_bytes_per_phase=tuple(wire_l),
+                       ici_bytes_per_phase=tuple(ici_l),
+                       dcn_bytes_per_phase=tuple(dcn_l),
+                       hop_bytes_per_phase=tuple(hop_l))
+
         hops = []
-        for p in range(schedule.num_phases):
-            total = 0
-            for i in range(schedule.peers_per_itr):
-                total += sum(
-                    _ring_hop(src, int(schedule.perms[p, i, src]), n)
-                    for src in range(n))
-            hops.append(total / max(1, n * schedule.peers_per_itr))
+        wire_l, ici_l, dcn_l, hop_l = [], [], [], []
+        wire = schedule.peers_per_itr * msg
+        for cross, same, hop_sum in classify(
+                schedule.perms, schedule.edge_weights,
+                schedule.num_phases, schedule.peers_per_itr):
+            hops.append(hop_sum / max(1, n * schedule.peers_per_itr))
+            dcn = int(round(cross * msg / n))
+            wire_l.append(wire)
+            dcn_l.append(dcn)
+            ici_l.append(wire - dcn)
+            hop_l.append(int(round(msg * hops[-1])))
         keep_rows: tuple[float, ...] = ()
         horizon = 0
         if faults is not None:
@@ -144,14 +237,17 @@ class CommModel:
             horizon = int(faults.horizon)
         return cls(mode="gossip", world=n, ppi=schedule.peers_per_itr,
                    num_phases=schedule.num_phases,
-                   payload_bytes=int(payload_bytes),
-                   exact_bytes=int(exact_bytes if exact_bytes is not None
-                                   else payload_bytes),
-                   msg_overhead_bytes=PS_WEIGHT_BYTES if ps_weight else 0,
+                   payload_bytes=payload, exact_bytes=exact,
+                   msg_overhead_bytes=overhead,
                    gossip_every=max(1, int(gossip_every)),
                    global_avg_every=max(0, int(global_avg_every)),
                    hops_per_phase=tuple(hops),
-                   keep_fraction_rows=keep_rows, keep_horizon=horizon)
+                   keep_fraction_rows=keep_rows, keep_horizon=horizon,
+                   slice_size=fabric,
+                   wire_bytes_per_phase=tuple(wire_l),
+                   ici_bytes_per_phase=tuple(ici_l),
+                   dcn_bytes_per_phase=tuple(dcn_l),
+                   hop_bytes_per_phase=tuple(hop_l))
 
     @classmethod
     def for_allreduce(cls, world: int, payload_bytes: int) -> "CommModel":
@@ -203,13 +299,23 @@ class CommModel:
             return out
         if self.gossip_fires(step):
             msg = self.payload_bytes + self.msg_overhead_bytes
-            wire = self.ppi * msg
-            out["gossip_wire"] = wire
+            if self.wire_bytes_per_phase:
+                p = self.phase_at(step)
+                wire = self.wire_bytes_per_phase[p]
+                out["gossip_wire"] = wire
+                out["gossip_ici"] = self.ici_bytes_per_phase[p]
+                out["gossip_dcn"] = self.dcn_bytes_per_phase[p]
+                out["gossip_hop_bytes"] = self.hop_bytes_per_phase[p]
+            else:
+                # bilat / hand-built models with no schedule tables: the
+                # whole exchange is one fabric (ICI lane by convention)
+                wire = self.ppi * msg
+                out["gossip_wire"] = out["gossip_ici"] = wire
+                hops = (self.hops_per_phase[self.phase_at(step)]
+                        if self.hops_per_phase else float(self.ppi))
+                out["gossip_hop_bytes"] = int(round(msg * hops))
             out["gossip_delivered"] = int(
                 round(wire * self.delivered_fraction(step)))
-            hops = (self.hops_per_phase[self.phase_at(step)]
-                    if self.hops_per_phase else float(self.ppi))
-            out["gossip_hop_bytes"] = int(round(msg * hops))
         if self.global_avg_fires(step):
             out["global_avg"] = allreduce_bytes(self.exact_bytes,
                                                 self.world)
@@ -237,7 +343,11 @@ class CommModel:
                 "global_avg_every": self.global_avg_every,
                 "hops_per_phase": [round(h, 4)
                                    for h in self.hops_per_phase],
-                "faulted": bool(self.keep_fraction_rows)}
+                "faulted": bool(self.keep_fraction_rows),
+                "slice_size": self.slice_size,
+                "hierarchical": self.hier,
+                "ici_bytes_per_phase": list(self.ici_bytes_per_phase),
+                "dcn_bytes_per_phase": list(self.dcn_bytes_per_phase)}
 
 
 class CommAccountant:

@@ -1,5 +1,7 @@
 """Gossip communication topologies, mixing strategies, and compiled schedules."""
 
+import functools
+
 from .graphs import (
     GraphTopology,
     DynamicDirectedExponentialGraph,
@@ -9,11 +11,17 @@ from .graphs import (
     DynamicBipartiteLinearGraph,
     RingGraph,
 )
+from .hierarchical import (
+    HierarchicalGraph,
+    HierarchicalSchedule,
+    default_slice_size,
+)
 from .mixing import MixingStrategy, SelfWeightedMixing, UniformMixing
 from .schedule import GossipSchedule, build_schedule, build_pairing_schedule
 
 # Integer registry kept flag-compatible with the reference CLI
-# (gossip_sgd.py:54-67).
+# (gossip_sgd.py:54-67); 6 is a TPU-native addition (two-level
+# multi-slice gossip, no reference counterpart).
 GRAPH_TOPOLOGIES = {
     0: DynamicDirectedExponentialGraph,
     1: DynamicBipartiteExponentialGraph,
@@ -21,6 +29,7 @@ GRAPH_TOPOLOGIES = {
     3: DynamicBipartiteLinearGraph,
     4: RingGraph,
     5: NPeerDynamicDirectedExponentialGraph,
+    6: HierarchicalGraph,
     -1: None,
 }
 
@@ -34,12 +43,17 @@ TOPOLOGY_NAMES = {
     "bipartite-linear": DynamicBipartiteLinearGraph,
     "ring": RingGraph,
     "npeer-exponential": NPeerDynamicDirectedExponentialGraph,
+    "hierarchical": HierarchicalGraph,
 }
 
 
 def topology_name(graph_class) -> str:
     """Stable name of a registered topology class (inverse of
-    :data:`TOPOLOGY_NAMES`)."""
+    :data:`TOPOLOGY_NAMES`).  Accepts a ``functools.partial`` over a
+    registered class — ``Plan.graph_class`` binds the planned slice
+    decomposition that way for hierarchical plans."""
+    if isinstance(graph_class, functools.partial):
+        graph_class = graph_class.func
     for name, cls in TOPOLOGY_NAMES.items():
         if cls is graph_class:
             return name
@@ -58,6 +72,9 @@ __all__ = [
     "DynamicDirectedLinearGraph",
     "DynamicBipartiteLinearGraph",
     "RingGraph",
+    "HierarchicalGraph",
+    "HierarchicalSchedule",
+    "default_slice_size",
     "MixingStrategy",
     "UniformMixing",
     "SelfWeightedMixing",

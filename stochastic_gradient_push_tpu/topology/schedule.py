@@ -71,9 +71,18 @@ class GossipSchedule:
 
 def build_schedule(graph: GraphTopology,
                    mixing: MixingStrategy | None = None) -> GossipSchedule:
-    """Compile ``graph`` + ``mixing`` into a :class:`GossipSchedule`."""
+    """Compile ``graph`` + ``mixing`` into a :class:`GossipSchedule`.
+
+    Graphs whose schedule is not phone-book rotation (the hierarchical
+    two-level topology) provide a ``compile_schedule`` hook and build
+    their own tables; everything downstream — verifier, planner,
+    collectives — consumes the same :class:`GossipSchedule` surface.
+    """
     if mixing is None:
         mixing = UniformMixing()
+    compile_hook = getattr(graph, "compile_schedule", None)
+    if compile_hook is not None:
+        return compile_hook(mixing)
     if graph.world_size == 1:
         ppi = graph.peers_per_itr
         return GossipSchedule(
@@ -127,6 +136,10 @@ def build_pairing_schedule(graph: GraphTopology) -> np.ndarray:
     n = graph.world_size
     if n == 1:
         return np.zeros((1, 1), dtype=np.int32)
+    if not getattr(graph, "supports_pairing", True):
+        raise ValueError(
+            f"{type(graph).__name__} is unsupported for bilateral "
+            "pairing: its ranks are not interchangeable partners")
     if n % 2:
         raise ValueError("bilateral pairing requires an even world size")
 

@@ -270,7 +270,9 @@ class Trainer:
                     topology=topo,
                     residual_floor=config.residual_floor,
                     cooldown_steps=config.health_every, log=self.log,
-                    registry=self.telemetry.registry)
+                    registry=self.telemetry.registry,
+                    interconnect=self._plan_interconnect(),
+                    faults=bool(config.inject_faults))
 
         # per-rank files: each process writes its local ranks; the single
         # aggregate file is process 0's job
@@ -281,6 +283,16 @@ class Trainer:
             f"{config.tag}out_r{r}_n{self.world_size}.csv")
 
     # -- algorithm / step construction ------------------------------------
+
+    def _plan_interconnect(self):
+        """Rebuild the fabric cost model stamped into the plan (None on a
+        uniform fabric) — comm-lane classification and recovery re-plans
+        must price on the same fabric the planner did."""
+        if self.cfg.plan and self.cfg.plan.get("interconnect"):
+            from ..planner import InterconnectModel
+
+            return InterconnectModel.from_dict(self.cfg.plan["interconnect"])
+        return None
 
     def _comm_dtype(self):
         """Resolve the wire-compression dtype; reject unknown values rather
@@ -410,11 +422,15 @@ class Trainer:
             wire = (tree_payload_bytes(state.params, self.gossip_world,
                                        itemsize=2)
                     if cfg.gossip_comm_dtype == "bf16" else exact)
+            # the fabric model the planner priced on classifies the
+            # wire's ICI/DCN lanes too (one source of truth)
+            interconnect = self._plan_interconnect()
             model = CommModel.from_schedule(
                 alg.schedule, wire, exact_bytes=exact,
                 gossip_every=alg.gossip_every,
                 global_avg_every=alg.global_avg_every,
-                faults=alg.faults, ps_weight=cfg.push_sum)
+                faults=alg.faults, ps_weight=cfg.push_sum,
+                interconnect=interconnect)
         self.telemetry.attach_comm(model)
         self.telemetry.registry.emit("run_meta", {
             "world": self.gossip_world, "algorithm": alg_name,

@@ -1,0 +1,22 @@
+"""Main half of the cross-module closure fixture.
+
+The jitted step calls a helper imported from ``crossmod_helper.py``.
+Standalone (``lint_file``) both files are clean; ``lint_paths`` over the
+pair resolves the import edge and flags the helper's host effect in the
+helper's own module (see ``test_sgplint.py::
+test_cross_module_closure_one_import_hop``).
+"""
+
+import jax
+
+from crossmod_helper import noisy_scale, quiet_report
+
+
+@jax.jit
+def step(x):
+    return noisy_scale(x)
+
+
+def host_summary(x):
+    # untraced caller: reaching quiet_report here must NOT mark it traced
+    return quiet_report(x)

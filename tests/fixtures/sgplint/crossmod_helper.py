@@ -1,0 +1,34 @@
+"""Helper half of the cross-module closure fixture.
+
+Standalone (``lint_file``) this module is clean: nothing in it is traced
+by its own decorators or wrappers.  Linted as a *set* with
+``bad_crossmod.py`` (``lint_paths``), the sibling's jitted step calls
+``noisy_scale`` through its import, so the one-hop closure marks it
+traced here and the host effect fires.  ``quiet_report`` is never
+reached from traced code and must stay silent — the closure is
+per-function, not per-module.
+"""
+
+import time
+
+import jax.numpy as jnp
+
+
+def noisy_scale(x):
+    t = time.time()  # EXPECT-CROSS: SGPL002 (via lint_paths only)
+    return x * jnp.asarray(t, x.dtype)
+
+
+def quiet_report(x):
+    print("host-side summary:", x)  # never called from traced code
+    return x
+
+
+class Reporter:
+    """A from-import can only bind a module-top-level name: this method
+    shares the imported helper's name but is unreachable through
+    ``from crossmod_helper import noisy_scale`` — the cross-module seed
+    must not mark it traced."""
+
+    def noisy_scale(self, x):
+        return x, time.time()  # untraced namesake: must stay silent

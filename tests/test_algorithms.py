@@ -197,6 +197,9 @@ def test_osgp_mass_conservation_with_in_flight(mesh):
     # with lr=0 the de-biased estimates converge to the initial mean
     for _ in range(60):
         params, gstate = f(params, gstate, TARGETS)
+        # serialize dispatch: XLA CPU in-process collectives deadlock
+        # when many executions are in flight (see run_alg)
+        jax.block_until_ready(params)
     z = debias(alg, np.asarray(params), gstate)
     np.testing.assert_allclose(
         z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=1e-3)
@@ -295,6 +298,9 @@ def test_osgp_bounded_staleness(mesh, staleness):
     # (staler mixing converges slower, so give it more rounds)
     for _ in range(120 * staleness):
         params, gstate = f(params, gstate, TARGETS)
+        # serialize dispatch: XLA CPU in-process collectives deadlock
+        # when many executions are in flight (see run_alg)
+        jax.block_until_ready(params)
     z = debias(alg, np.asarray(params), gstate)
     np.testing.assert_allclose(
         z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=2e-3)

@@ -1,0 +1,350 @@
+"""Two-level hierarchical gossip (topology/hierarchical.py).
+
+Covers the PR-8 tentpole end to end on CPU:
+
+* slice decomposition rules and constructor refusals;
+* schedule invariants through ``analysis.verify_schedule`` (the
+  two-level effective matrix is column-stochastic and mean-preserving,
+  including non-power-of-two slice counts and self-weighted mixing);
+* the pinned gap regression table at world 8/16/32/64;
+* compiled-round parity: the leader-``ppermute`` + grouped-``psum``
+  round equals the dense ``W_intra @ W_inter`` product the verifier
+  checks, on a real 8-device mesh;
+* the acceptance pin: at world 64 with DCN-dominant edge pricing the
+  planner selects the hierarchical topology, its schedule verifies, and
+  its inter-slice (DCN) bytes/step are strictly below the flat-gossip
+  winner's at the same gap floor.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.analysis import (
+    spectral_gap,
+    verify_schedule,
+)
+from stochastic_gradient_push_tpu.parallel import (
+    GOSSIP_AXIS,
+    gossip_round,
+    make_gossip_mesh,
+    mix_push_sum,
+)
+from stochastic_gradient_push_tpu.planner import (
+    InterconnectModel,
+    PlanConstraints,
+    plan_for,
+)
+from stochastic_gradient_push_tpu.telemetry import CommModel
+from stochastic_gradient_push_tpu.topology import (
+    TOPOLOGY_NAMES,
+    HierarchicalGraph,
+    HierarchicalSchedule,
+    SelfWeightedMixing,
+    build_pairing_schedule,
+    build_schedule,
+    default_slice_size,
+)
+
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= WORLD, "conftest must fake 8 devices"
+    return make_gossip_mesh(WORLD)
+
+
+def _per_rank_values(seed=0, shape=(4, 3)):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(WORLD,) + shape).astype(np.float32)
+
+
+# -- slice decomposition ----------------------------------------------------
+
+
+class TestSliceDecomposition:
+    def test_default_slice_sizes(self):
+        # few, large slices — the shape of real multi-slice pods
+        assert {w: default_slice_size(w)
+                for w in (4, 8, 12, 16, 24, 32, 48, 64)} == {
+                    4: 2, 8: 4, 12: 4, 16: 4, 24: 6, 32: 8, 48: 8, 64: 8}
+
+    @pytest.mark.parametrize("world", [1, 2, 3])
+    def test_worlds_below_two_slices_of_two_are_unsupported(self, world):
+        with pytest.raises(ValueError, match="unsupported|must be >="):
+            HierarchicalGraph(world)
+
+    def test_indivisible_slice_size_refused(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            HierarchicalGraph(8, slice_size=3)
+        with pytest.raises(ValueError, match="unsupported"):
+            HierarchicalGraph(8, slice_size=8)   # needs >= 2 slices
+
+    def test_dcn_fanout_bounds(self):
+        with pytest.raises(ValueError, match="dcn_fanout"):
+            HierarchicalGraph(16, dcn_fanout=0)
+        with pytest.raises(ValueError, match="dcn_fanout"):
+            HierarchicalGraph(16, slice_size=4, dcn_fanout=5)
+
+    def test_ppi_beyond_slice_phone_book_is_unsupported(self):
+        # 2 slices → the slice-level exponential graph has 1 peer max
+        with pytest.raises(ValueError):
+            HierarchicalGraph(8, peers_per_itr=3)
+
+    def test_pairing_refused(self):
+        # delegates are not interchangeable partners: bilateral pairing
+        # (AD-PSGD) has no meaning on a two-level schedule
+        assert HierarchicalGraph.supports_pairing is False
+        with pytest.raises(ValueError, match="unsupported"):
+            build_pairing_schedule(HierarchicalGraph(8))
+
+
+# -- schedule invariants ----------------------------------------------------
+
+
+class TestScheduleInvariants:
+    @pytest.mark.parametrize("world,slice_size,ppi", [
+        (8, None, 1), (16, None, 1), (32, None, 1), (64, None, 1),
+        (64, None, 2), (48, 8, 1), (24, 6, 1), (12, 4, 1), (64, 4, 1),
+        (64, 16, 2),
+    ])
+    def test_verifier_clean_over_grid(self, world, slice_size, ppi):
+        g = HierarchicalGraph(world, peers_per_itr=ppi,
+                              slice_size=slice_size)
+        sched = build_schedule(g)
+        findings, gap = verify_schedule(sched, f"hier-{world}", "<t>", 0)
+        assert findings == []
+        assert gap > 0.01  # every cell clears the planner's floor
+
+    def test_schedule_structure(self):
+        g = HierarchicalGraph(64)  # 8 slices of 8, fanout 2, 3 rounds
+        sched = build_schedule(g)
+        assert isinstance(sched, HierarchicalSchedule)
+        assert sched.rounds_per_cycle == 3
+        assert sched.num_phases == 6  # inter+intra table phases per round
+        assert sched.phase_kinds == ("inter", "intra") * 3
+        assert sched.slice_groups == tuple(
+            tuple(range(j * 8, (j + 1) * 8)) for j in range(8))
+        # the compact inter tables are what the compiled ppermute runs
+        inter = sched.inter_schedule
+        assert inter.num_phases == 3 and inter.peers_per_itr == 1
+
+    def test_mean_preserved_by_full_cycle_product(self):
+        # column-stochasticity per phase ⇒ the uniform-weight consensus
+        # value is the true mean (push-sum's core invariant)
+        sched = build_schedule(HierarchicalGraph(24, slice_size=6))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(24,))
+        prod = np.eye(24)
+        for p in range(sched.num_phases):
+            prod = sched.mixing_matrix(p) @ prod
+        assert np.allclose(prod.sum(axis=0), 1.0, atol=1e-12)
+        assert (prod @ x).mean() == pytest.approx(x.mean(), abs=1e-12)
+
+    def test_self_weighted_mixing_verifies(self):
+        g = HierarchicalGraph(16)
+        sched = build_schedule(g, SelfWeightedMixing(0.3))
+        findings, gap = verify_schedule(sched, "hier-sw", "<t>", 0)
+        assert findings == [] and 0.0 < gap <= 1.0
+
+    def test_out_peers_inter_and_intra(self):
+        g = HierarchicalGraph(8)  # 2 slices of 4, fanout 1
+        # phase 0 (inter): delegate 0 sends to the peer slice's delegate
+        assert g.out_peers(0, 0) == (4,)
+        assert g.out_peers(1, 0) == ()       # non-delegate: silent
+        # phase 1 (intra): everyone sends to its whole slice
+        assert set(g.out_peers(1, 1)) == {0, 2, 3}
+
+    def test_registered_in_both_registries(self):
+        from stochastic_gradient_push_tpu.topology import GRAPH_TOPOLOGIES
+        assert TOPOLOGY_NAMES["hierarchical"] is HierarchicalGraph
+        assert GRAPH_TOPOLOGIES[6] is HierarchicalGraph
+
+
+# -- pinned gap regression table --------------------------------------------
+
+
+class TestGapRegression:
+    """Future edits to the two-level schedule must not silently change
+    mixing behavior — same contract as the flat-graph table in
+    test_planner.py."""
+
+    @pytest.mark.parametrize("world,want", [
+        (8, 0.375), (16, 0.375), (32, 0.4375), (64, 0.4375),
+    ])
+    def test_default_decomposition(self, world, want):
+        sched = build_schedule(HierarchicalGraph(world))
+        assert spectral_gap(sched) == pytest.approx(want, rel=1e-6)
+
+    @pytest.mark.parametrize("world,slice_size,want", [
+        (48, 8, 0.4375),      # 6 slices — non-power-of-two slice count
+        (24, 6, 0.277778),    # 4 slices of 6
+        (12, 4, 0.457031),    # 3 slices of 4
+        (64, 4, 0.375),       # 16 small slices
+        (64, 16, 0.46875),    # 4 large slices
+    ])
+    def test_explicit_decompositions(self, world, slice_size, want):
+        sched = build_schedule(HierarchicalGraph(world,
+                                                 slice_size=slice_size))
+        assert spectral_gap(sched) == pytest.approx(want, rel=1e-4)
+
+    def test_gap_flat_across_slice_count_at_fixed_slice_size(self):
+        # slice-level rotation is exponential: adding slices at the same
+        # slice size does not collapse the gap (48 = 6 slices matches 64
+        # = 8 slices) — the property RingGraph lacks at pod scale
+        g48 = spectral_gap(build_schedule(HierarchicalGraph(48, slice_size=8)))
+        g64 = spectral_gap(build_schedule(HierarchicalGraph(64, slice_size=8)))
+        assert g48 == pytest.approx(g64, rel=1e-6)
+
+
+# -- compiled round parity --------------------------------------------------
+
+
+class TestCompiledRound:
+    def _round_fn(self, mesh, sched):
+        def step(phase, xs):
+            return gossip_round(xs, phase, sched, GOSSIP_AXIS)
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P(GOSSIP_AXIS)),
+            out_specs=P(GOSSIP_AXIS)))
+
+    def test_round_matches_two_level_matrices(self, mesh):
+        """One compiled round (leader ppermute + grouped psum) applies
+        exactly W_intra @ W_inter — the matrices the verifier checks."""
+        sched = build_schedule(HierarchicalGraph(WORLD, slice_size=4))
+        f = self._round_fn(mesh, sched)
+        x = _per_rank_values(seed=1)
+        for rnd in range(sched.rounds_per_cycle + 1):
+            got = np.asarray(f(jnp.int32(rnd), x))
+            q = rnd % sched.rounds_per_cycle
+            W = sched.mixing_matrix(2 * q + 1) @ sched.mixing_matrix(2 * q)
+            want = np.einsum("rs,s...->r...", W, x.astype(np.float64))
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_round_matches_with_two_by_two_slices(self, mesh):
+        sched = build_schedule(HierarchicalGraph(WORLD, slice_size=2))
+        f = self._round_fn(mesh, sched)
+        x = _per_rank_values(seed=2, shape=(3,))
+        got = np.asarray(f(jnp.int32(0), x))
+        W = sched.mixing_matrix(1) @ sched.mixing_matrix(0)
+        np.testing.assert_allclose(
+            got, np.einsum("rs,s...->r...", W, x.astype(np.float64)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_mass_conservation_and_push_sum_consensus(self, mesh):
+        sched = build_schedule(HierarchicalGraph(WORLD))
+        x = _per_rank_values(seed=3, shape=(5,))
+        w = np.ones((WORLD, 1), dtype=np.float32)
+        total, mean = x.sum(axis=0), x.mean(axis=0)
+
+        def step(phase, xs, ws):
+            return mix_push_sum(xs, ws, phase, sched, GOSSIP_AXIS)
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+            out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+        for rnd in range(40):
+            x, w = map(np.asarray, f(jnp.int32(rnd), x, w))
+            np.testing.assert_allclose(x.sum(axis=0), total,
+                                       rtol=1e-4, atol=1e-4)
+        debiased = x / w
+        np.testing.assert_allclose(
+            debiased, np.broadcast_to(mean, debiased.shape),
+            rtol=1e-4, atol=1e-4)
+
+    def test_no_recompilation_across_rounds(self, mesh):
+        sched = build_schedule(HierarchicalGraph(WORLD, slice_size=2))
+        assert sched.rounds_per_cycle > 1
+        x = _per_rank_values(seed=4, shape=(2,))
+        traces = 0
+
+        def step(phase, xs):
+            nonlocal traces
+            traces += 1
+            return gossip_round(xs, phase, sched, GOSSIP_AXIS)
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P(GOSSIP_AXIS)),
+            out_specs=P(GOSSIP_AXIS)))
+        for rnd in range(2 * sched.rounds_per_cycle):
+            f(jnp.int32(rnd), x)
+        assert traces == 1
+
+    def test_faults_and_overlap_rejected(self):
+        from stochastic_gradient_push_tpu.algorithms import sgp
+        from stochastic_gradient_push_tpu.resilience import \
+            parse_fault_spec
+
+        sched = build_schedule(HierarchicalGraph(WORLD))
+        with pytest.raises(ValueError, match="overlap"):
+            sgp(sched, GOSSIP_AXIS, overlap=True)
+        flat = build_schedule(
+            TOPOLOGY_NAMES["ring"](WORLD, peers_per_itr=1))
+        masks = parse_fault_spec("drop:0->1@0:4;seed:1").build_masks(flat)
+        with pytest.raises(ValueError, match="hierarchical"):
+            sgp(sched, GOSSIP_AXIS, faults=masks)
+        with pytest.raises(ValueError, match="hierarchical"):
+            gossip_round((np.zeros(2),), 0, sched, GOSSIP_AXIS,
+                         faults=masks)
+
+    def test_dpsgd_rejects_irregular_hierarchical(self):
+        from stochastic_gradient_push_tpu.algorithms import dpsgd
+
+        sched = build_schedule(HierarchicalGraph(WORLD))
+        with pytest.raises(ValueError, match="regular"):
+            dpsgd(sched, GOSSIP_AXIS)
+
+
+# -- world-64 acceptance pin ------------------------------------------------
+
+
+class TestWorld64Acceptance:
+    FABRIC = InterconnectModel(slice_size=8, dcn_cost=16.0)
+
+    def test_dcn_dominant_pricing_selects_hierarchical(self):
+        plan = plan_for(64, ppi=1, constraints=PlanConstraints(
+            interconnect=self.FABRIC))
+        assert plan.topology == "hierarchical"
+        assert plan.slice_size == 8 and not plan.below_floor()
+        assert plan.interconnect == self.FABRIC.to_dict()
+        # the planned graph class carries the slice decomposition
+        g = plan.graph_class(64, peers_per_itr=plan.ppi)
+        assert isinstance(g, HierarchicalGraph) and g.slice_size == 8
+
+    def test_selected_schedule_verifies(self):
+        plan = plan_for(64, ppi=1, constraints=PlanConstraints(
+            interconnect=self.FABRIC))
+        sched = build_schedule(plan.graph_class(64, peers_per_itr=1),
+                               plan.mixing_strategy())
+        findings, gap = verify_schedule(sched, "hier-acc", "<t>", 0)
+        assert findings == [] and gap >= plan.floor
+
+    def test_uniform_fabric_keeps_flat_winner(self):
+        assert plan_for(64, ppi=1).topology != "hierarchical"
+
+    def test_inter_slice_bytes_strictly_below_flat_at_same_floor(self):
+        """The measurable payoff: per-step DCN bytes drop by the gossip
+        sparsity factor versus the flat winner at the same gap floor."""
+        flat_plan = plan_for(64, ppi=1)   # uniform-fabric flat winner
+        flat = build_schedule(
+            TOPOLOGY_NAMES[flat_plan.topology](64, peers_per_itr=1))
+        hier = build_schedule(HierarchicalGraph(64, slice_size=8))
+        assert spectral_gap(flat) >= 0.01 and spectral_gap(hier) >= 0.01
+
+        payload = 100_000
+        steps = 96  # covers both rotation cycles (32 and 3) evenly
+        flat_b = CommModel.from_schedule(
+            flat, payload, interconnect=self.FABRIC).totals(steps)
+        hier_b = CommModel.from_schedule(
+            hier, payload, interconnect=self.FABRIC).totals(steps)
+        assert hier_b["gossip_dcn"] < flat_b["gossip_dcn"]
+        # the sparsity factor: only num_slices × fanout × ppi messages
+        # cross DCN per round vs (almost) world for the flat graph
+        assert hier_b["gossip_dcn"] < flat_b["gossip_dcn"] / 2
+        # both models account every wire byte into exactly two lanes
+        for b in (flat_b, hier_b):
+            assert b["gossip_ici"] + b["gossip_dcn"] == b["gossip_wire"]

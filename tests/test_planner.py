@@ -373,3 +373,209 @@ class TestHopCostModel:
         c = score_candidates(8, peer_counts=(1,))[0]
         d = json.loads(json.dumps(c.to_dict()))
         assert isinstance(d["hop_cost"], float)
+
+
+# -- tentpole: torus-aware DCN/ICI interconnect pricing ----------------------
+
+class TestInterconnectModel:
+    def test_edge_cost_semantics(self):
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+
+        m = InterconnectModel(slice_size=8, ici_cost=1.0, dcn_cost=16.0)
+        assert m.edge_cost(3, 3, 64) == 0.0            # loopback free
+        assert m.edge_cost(0, 1, 64) == 1.0            # 1 ICI hop
+        assert m.edge_cost(0, 7, 64) == 1.0            # ring wrap inside
+        assert m.edge_cost(0, 4, 64) == 4.0            # 4 hops on 1-D
+        assert m.edge_cost(7, 8, 64) == 16.0           # crosses DCN
+        assert m.edge_cost(0, 63, 64) == 16.0
+        assert m.is_cross_slice(7, 8) and not m.is_cross_slice(0, 7)
+
+    def test_torus_dims_shorten_intra_slice_paths(self):
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+
+        ring = InterconnectModel(slice_size=16)
+        torus = InterconnectModel(slice_size=16, torus=(4, 4))
+        # rank 0 -> 10 = (row 2, col 2) on the 4x4 torus: 2+2 hops,
+        # vs min(10, 6) on the 1-D ring
+        assert ring.edge_cost(0, 10, 16) == 6.0
+        assert torus.edge_cost(0, 10, 16) == 4.0
+        with pytest.raises(ValueError, match="do not tile"):
+            InterconnectModel(slice_size=16, torus=(4, 3))
+
+    def test_uniform_model_reproduces_ring_hop_ranking(self):
+        """With no fabric structure the priced cost IS the old hop cost:
+        rankings on a uniform fabric are unchanged by construction."""
+        for c in score_candidates(16, peer_counts=(1, 2)):
+            assert c.priced_cost == pytest.approx(c.hop_cost)
+            assert c.dcn_per_efold == 0.0
+
+    def test_make_interconnect_resolves_defaults(self):
+        from stochastic_gradient_push_tpu.planner import (
+            DEFAULT_DCN_COST, make_interconnect)
+
+        assert make_interconnect() is None     # no fabric flags: uniform
+        m = make_interconnect(slice_size=4)
+        assert m.slice_size == 4 and m.dcn_cost == DEFAULT_DCN_COST
+        assert make_interconnect(slice_size=4, dcn_cost=32.0).dcn_cost \
+            == 32.0
+        # a DCN weight with no slice structure could never apply — reject
+        # rather than silently price a uniform fabric
+        with pytest.raises(ValueError, match="slice_size"):
+            make_interconnect(dcn_cost=32.0)
+
+    def test_uniform_fabric_torus_must_tile_the_world(self):
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+
+        m = InterconnectModel(torus=(4, 4))   # legal: world checked later
+        assert m.torus_hops(0, 10, 16) == 4   # (2, 2) on the 4x4 torus
+        with pytest.raises(ValueError, match="do not tile"):
+            m.edge_cost(0, 16, 64)            # 4*4 != 64: no silent 0-hop
+
+
+class TestHierarchicalRanking:
+    def _fabric(self, dcn=16.0):
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+        return InterconnectModel(slice_size=8, dcn_cost=dcn)
+
+    def test_dcn_dominant_fabric_flips_the_world64_winner(self):
+        cons = PlanConstraints(interconnect=self._fabric())
+        plan = plan_for(64, ppi=1, constraints=cons)
+        assert plan.topology == "hierarchical" and plan.slice_size == 8
+        assert "DCN" in plan.rationale
+        # the stamped ranking shows flat candidates priced higher
+        flat = [r for r in plan.ranking if r["topology"] != "hierarchical"]
+        assert flat and all(r["priced_cost"] > plan.ranking[0]["priced_cost"]
+                            for r in flat)
+
+    def test_uniform_fabric_keeps_flat_winner(self):
+        plan = plan_for(64, ppi=1)
+        assert plan.topology != "hierarchical"
+        # hierarchical is scored (present) but loses without DCN weight
+        names = {r["topology"] for r in plan.ranking}
+        assert "hierarchical" in names
+
+    def test_mildly_priced_dcn_does_not_flip(self):
+        # at DCN == ICI the hierarchical intra-slice allreduce is pure
+        # overhead; the flip threshold is what the model exists to find
+        plan = plan_for(64, ppi=1, constraints=PlanConstraints(
+            interconnect=self._fabric(dcn=1.0)))
+        assert plan.topology != "hierarchical"
+
+    def test_forced_hierarchical_checks_and_stamps_slice(self):
+        from stochastic_gradient_push_tpu.topology import HierarchicalGraph
+
+        plan = check_topology(64, HierarchicalGraph, ppi=1,
+                              interconnect=self._fabric())
+        assert not plan.auto and plan.topology == "hierarchical"
+        assert plan.slice_size == 8 and not plan.below_floor()
+        assert not plan.warnings
+
+    def test_fabric_slice_size_overrides_default_decomposition(self):
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+        from stochastic_gradient_push_tpu.topology import HierarchicalGraph
+
+        plan = check_topology(
+            64, HierarchicalGraph, ppi=1,
+            interconnect=InterconnectModel(slice_size=16, dcn_cost=16.0))
+        assert plan.slice_size == 16
+        g = plan.graph_class(64, peers_per_itr=1)
+        assert g.slice_size == 16
+
+    def test_plan_dict_roundtrips_with_interconnect(self):
+        plan = plan_for(64, ppi=1, constraints=PlanConstraints(
+            interconnect=self._fabric()))
+        d = json.loads(json.dumps(plan.to_dict()))
+        assert d["slice_size"] == 8
+        assert d["interconnect"]["dcn_cost"] == 16.0
+
+    def test_resolve_topology_threads_interconnect(self):
+        log = _FakeLog()
+        plan = resolve_topology(64, topology="auto",
+                                interconnect=self._fabric(), log=log)
+        assert plan.topology == "hierarchical"
+        assert any("hierarchical" in m for m in log.infos)
+
+    def test_dpsgd_auto_plan_never_selects_irregular_hierarchical(self):
+        # D-PSGD needs doubly-stochastic mixing; the hierarchical
+        # schedule is irregular, so even on a DCN-dominant fabric the
+        # planner must rank it out rather than recommend a topology the
+        # algorithm would reject at launch
+        plan = plan_for(64, ppi=1, algorithm="dpsgd",
+                        constraints=PlanConstraints(
+                            interconnect=self._fabric()))
+        assert plan.topology != "hierarchical"
+
+    def test_dpsgd_forced_hierarchical_rejected_at_plan_time(self):
+        from stochastic_gradient_push_tpu.topology import HierarchicalGraph
+
+        with pytest.raises(ValueError, match="regular"):
+            check_topology(64, HierarchicalGraph, ppi=1, algorithm="dpsgd",
+                           interconnect=self._fabric())
+
+    @pytest.mark.parametrize("mode", ["overlap", "faults"])
+    def test_overlap_and_faults_runs_never_plan_hierarchical(self, mode):
+        # PushSumGossip rejects hierarchical schedules under overlap and
+        # fault injection; even on a DCN-dominant fabric the planner must
+        # rank hierarchical out instead of crashing the launch
+        cons = PlanConstraints(interconnect=self._fabric(),
+                               **{mode: True})
+        plan = plan_for(64, ppi=1, constraints=cons)
+        assert plan.topology != "hierarchical"
+
+    @pytest.mark.parametrize("mode", ["overlap", "faults"])
+    def test_forced_hierarchical_rejected_for_overlap_and_faults(self, mode):
+        from stochastic_gradient_push_tpu.topology import HierarchicalGraph
+
+        with pytest.raises(ValueError, match="flat-schedule"):
+            check_topology(64, HierarchicalGraph, ppi=1,
+                           interconnect=self._fabric(), **{mode: True})
+
+    def test_hierarchical_plan_graph_class_keeps_its_name(self):
+        # Plan.graph_class binds slice_size via functools.partial; the
+        # recovery policy resolves it back through topology_name
+        plan = plan_for(64, ppi=1, constraints=PlanConstraints(
+            interconnect=self._fabric()))
+        assert topology_name(plan.graph_class) == "hierarchical"
+
+
+# -- satellite: spectral-gap memoization ------------------------------------
+
+class TestSpectralGapCache:
+    def test_identical_tables_hit_the_cache(self):
+        from stochastic_gradient_push_tpu.analysis import (
+            spectral_gap_cache_clear, spectral_gap_cache_info)
+
+        spectral_gap_cache_clear()
+        s1 = build_schedule(RingGraph(16, peers_per_itr=1))
+        s2 = build_schedule(RingGraph(16, peers_per_itr=1))  # fresh object
+        g1, g2 = spectral_gap(s1), spectral_gap(s2)
+        assert g1 == g2
+        info = spectral_gap_cache_info()
+        assert info == {"hits": 1, "misses": 1, "size": 1}
+
+    def test_different_tables_miss(self):
+        from stochastic_gradient_push_tpu.analysis import (
+            schedule_fingerprint, spectral_gap_cache_clear,
+            spectral_gap_cache_info)
+
+        spectral_gap_cache_clear()
+        a = build_schedule(RingGraph(8, peers_per_itr=1))
+        b = build_schedule(DynamicDirectedExponentialGraph(8))
+        assert schedule_fingerprint(a) != schedule_fingerprint(b)
+        spectral_gap(a), spectral_gap(b)
+        assert spectral_gap_cache_info()["misses"] == 2
+
+    def test_repeated_plan_for_stops_recomputing_eigenvalues(self):
+        """The satellite's pin: a second identical plan_for call in the
+        same process does zero new eigenvalue solves."""
+        from stochastic_gradient_push_tpu.analysis import (
+            spectral_gap_cache_clear, spectral_gap_cache_info)
+
+        spectral_gap_cache_clear()
+        plan_for(32)
+        first = spectral_gap_cache_info()
+        assert first["misses"] > 0
+        plan_for(32)
+        second = spectral_gap_cache_info()
+        assert second["misses"] == first["misses"]   # all cache hits
+        assert second["hits"] > first["hits"]

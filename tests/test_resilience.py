@@ -430,6 +430,30 @@ class TestRecovery:
         assert event.suggestion["switch"] is True
         assert 0.0 < event.suggestion["gap"] <= 1.0
 
+    def test_replan_prices_on_the_run_fabric(self):
+        # a hierarchical run on a DCN-dominant pod must not be advised
+        # to switch to a flat graph just because the re-plan forgot the
+        # fabric it was planned on
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+
+        fabric = InterconnectModel(slice_size=8, dcn_cost=16.0)
+        pol = RecoveryPolicy(world=64, topology="hierarchical",
+                             cooldown_steps=0, interconnect=fabric)
+        suggestion = pol.replan()
+        assert suggestion["topology"] == "hierarchical"
+        assert suggestion["switch"] is False
+
+    def test_replan_honors_fault_injection(self):
+        # a fault-injected run cannot relaunch on a hierarchical schedule
+        # (per-edge masks don't decompose across the grouped psum), so
+        # the suggestion must stay flat even on a DCN-dominant fabric
+        from stochastic_gradient_push_tpu.planner import InterconnectModel
+
+        fabric = InterconnectModel(slice_size=8, dcn_cost=16.0)
+        pol = RecoveryPolicy(world=64, cooldown_steps=0,
+                             interconnect=fabric, faults=True)
+        assert pol.replan()["topology"] != "hierarchical"
+
     def test_cooldown_and_circuit_breaker(self):
         pol = RecoveryPolicy(world=8, cooldown_steps=10, max_recoveries=2)
         assert pol.assess(self._report(step=0)).action == "global-average"

@@ -151,6 +151,33 @@ def test_every_fired_rule_is_cataloged_and_coverage_is_broad():
     assert any(r.startswith("SGPV") for r in fired)
 
 
+def test_cross_module_closure_one_import_hop():
+    """Satellite: a traced function calling a helper imported from a
+    sibling module marks the helper traced in its own module — but only
+    when the files are linted as a set (lint_paths), and only along
+    actually-called edges."""
+    main = os.path.join(FIXDIR, "bad_crossmod.py")
+    helper = os.path.join(FIXDIR, "crossmod_helper.py")
+
+    # standalone, neither half fires: the import edge is invisible
+    assert lint_file(main, AXES, relto=FIXDIR) == []
+    assert lint_file(helper, AXES, relto=FIXDIR) == []
+
+    findings = lint_paths([main, helper], axes=AXES, relto=FIXDIR)
+    assert [(f.file, f.rule) for f in findings] == [
+        ("crossmod_helper.py", "SGPL002")]
+    # the finding lands on the helper's time.time() line, per its
+    # EXPECT-CROSS marker
+    marked = [i for i, l in enumerate(_read(helper).splitlines(), 1)
+              if "EXPECT-CROSS" in l]
+    assert [f.line for f in findings] == marked
+    # quiet_report is only reached from an UNTRACED caller: its print()
+    # must not fire (the closure is per-function, not per-module), and
+    # Reporter.noisy_scale — a class-method namesake of the imported
+    # helper — must not be seeded (a from-import binds only module
+    # top-level names); the exact-match assertion above pins both
+
+
 def test_suppression_comment_is_honored():
     # the tagged_ok handler in bad_except.py carries a disable tag and
     # must NOT appear among findings (already covered by the exact-match

@@ -529,3 +529,18 @@ def test_bench_gossip_vs_ar_mode(tmp_path, monkeypatch):
     # modeled comm: gossip+GA moves fewer bytes than AR-every-step
     mb = doc["bench"]["modeled_bytes_per_rank"]
     assert mb["sgp_ga"] < mb["allreduce"]
+
+
+def test_bench_gva_topology_arg_both_spellings():
+    """The parent must honor --topology NAME and --topology=NAME alike —
+    a silently ignored '=' spelling would stamp flat-ring numbers into a
+    hierarchical calibration run."""
+    bench = _load_script("bench.py", "bench_gva_argparse_under_test")
+    argv = ["bench.py", "--gossip-vs-ar"]
+    assert bench._gva_topology_arg(argv) is None
+    assert bench._gva_topology_arg(
+        argv + ["--topology", "hierarchical"]) == "hierarchical"
+    assert bench._gva_topology_arg(
+        argv + ["--topology=hierarchical"]) == "hierarchical"
+    with pytest.raises(SystemExit):
+        bench._gva_topology_arg(argv + ["--topology"])
