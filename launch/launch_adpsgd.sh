@@ -2,4 +2,4 @@
 # AD-PSGD (≙ submit_ADPSGD_ETH.sh): bilateral pairwise averaging over
 # rotating perfect matchings.
 source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
-$RUN_ADPSGD "${COMMON_ARGS[@]}" --tag 'ADPSGD_TPU' "$@"
+exec $RUN_ADPSGD "${COMMON_ARGS[@]}" --tag 'ADPSGD_TPU' "$@"

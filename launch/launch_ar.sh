@@ -1,5 +1,5 @@
 #!/bin/bash
 # AllReduce-SGD baseline (≙ submit_AR_IB.sh): exact psum averaging.
 source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
-$RUN "${COMMON_ARGS[@]}" \
+exec $RUN "${COMMON_ARGS[@]}" \
   --all_reduce True --graph_type -1 --tag 'AR_TPU' "$@"

@@ -2,5 +2,5 @@
 # D-PSGD (≙ submit_DPSGD_IB.sh): doubly-stochastic push-pull gossip on
 # the bipartite exponential graph.
 source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
-$RUN "${COMMON_ARGS[@]}" \
+exec $RUN "${COMMON_ARGS[@]}" \
   --push_sum False --graph_type 1 --all_reduce False --tag 'DPSGD_TPU' "$@"

@@ -2,6 +2,6 @@
 # Overlap SGP: gossip for step k consumed at step k+1, collective
 # overlapped with backprop by XLA (≙ SGP scripts with --overlap True).
 source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
-$RUN "${COMMON_ARGS[@]}" \
+exec $RUN "${COMMON_ARGS[@]}" \
   --push_sum True --overlap True --graph_type 0 --all_reduce False \
   --tag 'OSGP_TPU' "$@"

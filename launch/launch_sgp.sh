@@ -2,5 +2,5 @@
 # Synchronous Stochastic Gradient Push (≙ submit_SGP_IB.sh):
 # directed exponential graph, push-sum gossip.
 source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
-$RUN "${COMMON_ARGS[@]}" \
+exec $RUN "${COMMON_ARGS[@]}" \
   --push_sum True --graph_type 0 --all_reduce False --tag 'SGP_TPU' "$@"

@@ -1,0 +1,67 @@
+#!/bin/bash
+# Supervised launch: wrap any launch_*.sh training command in the
+# elastic run supervisor (scripts/supervise.py) so preemption, rank
+# loss, and sustained re-plan suggestions requeue THROUGH the
+# supervisor — checkpoint, reshard to the surviving world, replan,
+# relaunch — instead of dying with the mesh or requeueing raw srun.
+#
+# Usage (same shape as the raw scripts, plus the supervisor knobs):
+#
+#   single host:   bash launch/launch_supervised.sh launch_sgp.sh \
+#                    --world_size 32 --trace_dir /runs/t1
+#   SLURM:         sbatch --nodes=1 --signal=USR1@120 \
+#                    launch/launch_supervised.sh launch_sgp.sh ...
+#
+# The first argument names a sibling launch script (or "lm" for the LM
+# harness); everything after it is passed to the training CLI.  The
+# child MUST get a --trace_dir (the supervisor acts on the typed event
+# stream) — add --metrics_every/--health_every for a live heartbeat.
+#
+# Supervisor knobs ride in env vars so the training argv stays clean:
+#   SUPERVISE_ARGS     extra scripts/supervise.py flags
+#                      (e.g. "--max_restarts 5 --min_world 4")
+#   CHECKPOINT_DIR     as in common.sh
+#
+# Exit 75 means "preempted after checkpoint, requeue me": under SLURM
+# the supervisor already drained the child, so we requeue the job
+# rather than letting the allocation lapse mid-epoch.
+
+set -uo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_ROOT:${PYTHONPATH:-}"
+
+target="${1:?usage: launch_supervised.sh <launch_xxx.sh|lm> [child args...]}"
+shift
+
+tag_flag=()
+if [ "$target" = "lm" ]; then
+    child=(python -u -m stochastic_gradient_push_tpu.run.gossip_lm "$@")
+else
+    # reuse the sibling script's canonical hyperparameters verbatim;
+    # the launch scripts exec the trainer, so the supervisor's drain
+    # signals reach the python process directly
+    child=(bash "$REPO_ROOT/launch/$target" "$@")
+    # the scripts set their checkpoint --tag internally where ChildSpec
+    # cannot see it; mirror it to the supervisor (operator "$@" wins)
+    case " $* " in *" --tag "*) ;; *)
+        case "$target" in
+            launch_sgp.sh)    tag_flag=(--tag SGP_TPU) ;;
+            launch_ar.sh)     tag_flag=(--tag AR_TPU) ;;
+            launch_dpsgd.sh)  tag_flag=(--tag DPSGD_TPU) ;;
+            launch_osgp.sh)   tag_flag=(--tag OSGP_TPU) ;;
+            launch_adpsgd.sh) tag_flag=(--tag ADPSGD_TPU) ;;
+        esac ;;
+    esac
+fi
+
+# shellcheck disable=SC2086
+python "$REPO_ROOT/scripts/supervise.py" ${SUPERVISE_ARGS:-} \
+    "${tag_flag[@]}" -- "${child[@]}"
+rc=$?
+
+if [ "$rc" -eq 75 ] && [ -n "${SLURM_JOB_ID:-}" ]; then
+    echo "launch_supervised: preempted after checkpoint; requeueing" \
+         "job $SLURM_JOB_ID" >&2
+    scontrol requeue "$SLURM_JOB_ID"
+fi
+exit "$rc"

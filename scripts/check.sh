@@ -29,6 +29,10 @@ echo "== obsreport self-check (telemetry: tracer -> events -> report) =="
 python scripts/obsreport.py --selftest
 
 echo
+echo "== supervise self-check (elastic: kill a rank -> reshard -> relaunch) =="
+python scripts/supervise.py --selftest
+
+echo
 echo "== tier-1 tests (CPU, not slow) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

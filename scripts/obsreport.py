@@ -35,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from stochastic_gradient_push_tpu.telemetry import (  # noqa: E402
     EVENTS_FILE,
     SCHEMA_VERSION,
+    SUPERVISOR_EVENTS_FILE,
     TRACE_FILE,
 )
 from stochastic_gradient_push_tpu.utils.meter import (  # noqa: E402
@@ -46,13 +47,16 @@ from stochastic_gradient_push_tpu.utils.meter import (  # noqa: E402
 
 def _event_files(run_dir: str) -> list[str]:
     """events.jsonl plus any per-process events_rN.jsonl siblings (a
-    multi-process run writes one file per rank to avoid interleaving)."""
+    multi-process run writes one file per rank to avoid interleaving)
+    plus the supervisor's own stream (supervisor.jsonl — the restart
+    timeline lives there)."""
     import glob
 
     base, ext = os.path.splitext(EVENTS_FILE)
     return sorted(
         glob.glob(os.path.join(run_dir, EVENTS_FILE))
-        + glob.glob(os.path.join(run_dir, f"{base}_r*{ext}")))
+        + glob.glob(os.path.join(run_dir, f"{base}_r*{ext}"))
+        + glob.glob(os.path.join(run_dir, SUPERVISOR_EVENTS_FILE)))
 
 
 def load_events(run_dir: str) -> list[dict]:
@@ -202,6 +206,23 @@ def build_report(run_dir: str) -> dict:
     run_meta = by_kind.get("run_meta", [])
     plan = by_kind.get("plan", [])
 
+    # restart timeline: one row per generation boundary (supervisor
+    # relaunch events), annotated with the per-generation world/topology
+    # and the supervisor-measured recovery time
+    relaunches = sorted(by_kind.get("relaunch", []),
+                        key=lambda ev: ev.get("t", 0.0))
+    supervisor_evs = by_kind.get("supervisor", [])
+    restart_timeline = [
+        {"generation": ev["data"].get("generation"),
+         "world": ev["data"].get("world"),
+         "prev_world": ev["data"].get("prev_world"),
+         "topology": ev["data"].get("topology"),
+         "reason": ev["data"].get("reason"),
+         "resharded": ev["data"].get("resharded"),
+         "mean_drift": ev["data"].get("mean_drift"),
+         "time_to_recover_s": ev["data"].get("time_to_recover_s")}
+        for ev in relaunches]
+
     report = {
         "run_dir": run_dir,
         "trace_present": trace_present,
@@ -229,6 +250,11 @@ def build_report(run_dir: str) -> dict:
                                for ev in recoveries}),
         },
         "heartbeat_stalls": len(heartbeats),
+        "restarts": {
+            "supervised": bool(supervisor_evs or relaunches),
+            "generations": len(relaunches) + 1,
+            "timeline": restart_timeline,
+        },
         "comm": comm_final,
         "ckpt_meta": load_ckpt_meta(run_dir),
     }
@@ -274,6 +300,21 @@ def render(report: dict) -> str:
     lines.append(f"recoveries: {report['recoveries']['count']} "
                  f"{report['recoveries']['actions']}")
     lines.append(f"heartbeat stalls: {report['heartbeat_stalls']}")
+    rs = report.get("restarts") or {}
+    if rs.get("supervised"):
+        lines.append(f"restarts: {rs['generations']} generation(s), "
+                     f"{len(rs['timeline'])} relaunch(es)")
+        for r in rs["timeline"]:
+            drift = (f", mean drift {r['mean_drift']:.2e}"
+                     if r.get("mean_drift") is not None else "")
+            shape = (f"world {r['prev_world']} -> {r['world']}"
+                     if r.get("prev_world") != r.get("world")
+                     else f"world {r['world']}")
+            lines.append(
+                f"   gen {r['generation']}: {shape}, topology "
+                f"{r.get('topology')}, {r.get('reason')}"
+                f" (recovered in {r.get('time_to_recover_s')}s"
+                f"{drift})")
     c = report["comm"]
     if c:
         by = c.get("bytes", {})
@@ -354,6 +395,22 @@ def selftest() -> int:
             pass
         rt.finish(step=num_steps - 1)
 
+        # a supervised run: the supervisor writes its own stream
+        # (supervisor.jsonl) that the report renders as the restart
+        # timeline
+        from stochastic_gradient_push_tpu.telemetry import (
+            JsonlSink, TelemetryRegistry)
+        sup = TelemetryRegistry(rank=0, sinks=[JsonlSink(
+            os.path.join(d, SUPERVISOR_EVENTS_FILE))])
+        sup.emit("supervisor", {"action": "launch", "generation": 0,
+                                "world": 8})
+        sup.emit("relaunch", {
+            "generation": 1, "world": 4, "prev_world": 8,
+            "reason": "child-exit (code -9)", "topology": "ring",
+            "resharded": True, "mean_drift": 1.2e-7,
+            "time_to_recover_s": 2.5}, severity="warning")
+        sup.close()
+
         report = build_report(d)
         print(render(report))
 
@@ -375,6 +432,13 @@ def selftest() -> int:
         expect(report["health"]["excursions"] == 1, "one excursion")
         expect(report["recoveries"]["count"] == 1, "one recovery")
         expect(report["heartbeat_stalls"] == 1, "one stall")
+        rs = report["restarts"]
+        expect(rs["supervised"] and rs["generations"] == 2,
+               f"restart timeline generations: {rs}")
+        expect(rs["timeline"] and rs["timeline"][0]["world"] == 4
+               and rs["timeline"][0]["prev_world"] == 8
+               and rs["timeline"][0]["topology"] == "ring",
+               f"restart timeline row: {rs['timeline']}")
         # the analytic gate: reported bytes equal the model's expectation
         want = model.totals(num_steps)
         want["recovery"] = allreduce_bytes(payload, 8)

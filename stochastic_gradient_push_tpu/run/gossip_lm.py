@@ -719,7 +719,8 @@ def main(argv=None):
     from ..parallel.multihost import (consensus_resume_point,
                                       global_state_from_local,
                                       host_local_slice, to_host)
-    from ..utils.checkpoint import CheckpointManager
+    from ..utils.checkpoint import (REQUEUE_EXIT_CODE, CheckpointManager,
+                                    ClusterManager)
 
     # ep/tp/pp multihost states shard on non-leading dims — the rank-row
     # msgpack slicing cannot represent them, but orbax's global-state mode
@@ -738,6 +739,22 @@ def main(argv=None):
         ckpt = CheckpointManager(args.checkpoint_dir, tag=args.tag,
                                  rank=proc_index, world_size=world,
                                  all_workers=proc_count > 1)
+    # preemption handling (≙ the image harness): SIGUSR1/SIGTERM raise a
+    # flag; the step loop below finishes the in-flight step, checkpoints,
+    # emits the final run_meta event, and exits with the requeue status
+    # the supervisor (supervise/) keys on.  No requeue command: the LM
+    # harness leaves relaunching to the supervisor/launch layer
+    cluster = ClusterManager(ckpt, rank=proc_index, requeue_command=None)
+    if sb(args.resume) and not use_orbax and not ckpt.exists() \
+            and pp == ep == tp == 1 and sp == 1 and proc_count == 1:
+        # a resized relaunch: another world's checkpoint set may exist —
+        # reshard it (exact-average consensus collapse) instead of
+        # silently cold-starting.  Flat dp meshes only: sharded-dim
+        # states (sp/tp/ep/pp) don't stack rank rows on dim 0
+        from ..supervise.reshard import maybe_cross_world_reshard
+
+        maybe_cross_world_reshard(args.checkpoint_dir, args.tag, world,
+                                  log=log)
     shardings = jax.tree.map(lambda a: a.sharding, state)
     start_step = 0
     if sb(args.resume) and proc_count > 1:
@@ -1093,6 +1110,26 @@ def main(argv=None):
                 if args.ckpt_every and steps_done % args.ckpt_every == 0:
                     save_ckpt(state, steps_done)
                     last_saved = steps_done
+                if cluster.any_rank_signalled():
+                    # preemption: the in-flight step is done — save,
+                    # record the exit reason, exit with the requeue code
+                    log.warning(
+                        "preemption signal (%s): checkpointing at step "
+                        "%d and exiting %d (requeue me)",
+                        cluster.last_signal or "peer flag", steps_done,
+                        REQUEUE_EXIT_CODE)
+                    save_ckpt(state, steps_done)
+                    last_saved = steps_done
+                    if use_orbax:
+                        ckpt.wait()
+                        ckpt.close()
+                    if rt.enabled:
+                        rt.registry.emit("run_meta", {
+                            "exit_reason": "preempt-requeue",
+                            "signal": cluster.last_signal,
+                            "exit_code": REQUEUE_EXIT_CODE},
+                            step=steps_done, severity="warning")
+                    raise SystemExit(REQUEUE_EXIT_CODE)
                 if steps_done >= args.num_steps:
                     break
             epoch += 1

@@ -612,6 +612,10 @@ def _multihost_env() -> bool:
 
 
 def _default_requeue() -> str | None:
+    if os.environ.get("SGP_SUPERVISED") == "1":
+        # the run supervisor (supervise/) owns the relaunch decision —
+        # requeueing from inside the child would race it
+        return None
     job_id = os.environ.get("SLURM_JOB_ID")
     return f"scontrol requeue {job_id}" if job_id else None
 

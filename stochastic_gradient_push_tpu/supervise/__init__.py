@@ -1,0 +1,57 @@
+"""supervise/ — elastic run supervisor: the host-side control loop.
+
+Four subsystems already exist below this package: the planner decides
+topologies (planner/), the resilience monitor sees divergence and its
+recovery policy logs re-plan suggestions (resilience/), telemetry turns
+both into one typed ``events.jsonl`` stream (telemetry/), and the
+checkpoint layer can save/restore per-rank state (utils/checkpoint.py).
+None of them can *act* on a lost rank or a sustained re-plan suggestion:
+a compiled SPMD mesh is fixed for the life of the process, so topology
+switching and world resizing are relaunch decisions — and before this
+package nothing made them.
+
+The supervisor closes the loop from outside the mesh (≙ the reference's
+``ClusterManager`` preemption/requeue layer, cluster_manager.py:24-141,
+generalized from "requeue the same job" to "resize and replan the run"):
+
+* :mod:`.tailer` — incremental ``events.jsonl`` reader, robust to
+  partial trailing lines, truncation/rotation, and unknown kinds;
+* :mod:`.policy` — debounced decision state machine: a *sustained*
+  re-plan suggestion (``suggestion.switch`` held past the cooldown), a
+  stalled rank (watchdog heartbeat), a child crash, or a preemption
+  signal each map to one supervisor action;
+* :mod:`.reshard` — world-resize for per-rank checkpoints: exact-average
+  consensus collapse (``x̄ = Σ params / Σ ps_weight``, the same algebra
+  as ``PushSumGossip.global_average``) then re-stack at the surviving
+  world size — the parameter mean is preserved across the restart
+  boundary *by construction*;
+* :mod:`.supervisor` — the lifecycle owner: launches the training CLI as
+  a managed child, drains it through the SIGUSR1 checkpoint path, and
+  relaunches with fresh ``planner.plan_for`` flags.
+
+``scripts/supervise.py`` is the operator entry point;
+``--selftest`` runs the chaos acceptance loop (kill a rank mid-run →
+reshard 8→4 → relaunch on a fresh plan, mean preserved to f32
+tolerance) that ``scripts/check.sh`` gates on.
+"""
+
+from .policy import Action, SupervisorPolicy
+from .reshard import (
+    ReshardReport,
+    TornCheckpointError,
+    consensus_mean,
+    load_world_checkpoint,
+    maybe_cross_world_reshard,
+    reshard_checkpoints,
+    reshard_state,
+)
+from .supervisor import ChildSpec, Supervisor
+from .tailer import EventTailer
+
+__all__ = [
+    "Action", "SupervisorPolicy",
+    "ReshardReport", "TornCheckpointError", "consensus_mean",
+    "load_world_checkpoint", "maybe_cross_world_reshard",
+    "reshard_checkpoints", "reshard_state",
+    "ChildSpec", "Supervisor", "EventTailer",
+]

@@ -1,0 +1,325 @@
+"""Reshard world-stacked per-rank checkpoints to a new world size.
+
+A decentralized run's checkpoint holds *different* parameters per rank
+(``checkpoint_r{proc}_n{world}.ckpt``, each file the rank rows its
+process owned), and the compiled mesh that wrote it can only be rebuilt
+at exactly that world size — so losing a rank used to mean losing the
+run.  This module is the restart-boundary transform:
+
+1. **collapse** — the exact push-sum consensus ``x̄ = Σᵢ paramsᵢ / Σᵢ
+   ps_weightᵢ`` over the old world (the same algebra as
+   ``PushSumGossip.global_average`` and the planner's periodic-global-
+   averaging fallback, Chen et al.; mass conservation makes that ratio
+   the true network mean under any column-stochastic mixing);
+2. **re-stack** — replicate the consensus at the surviving world size
+   with ``ps_weight`` reset to 1 and the gossip phase reset to 0 (the
+   new world runs a new schedule whose phase count may differ).
+
+The network-wide parameter mean is therefore preserved across the
+restart boundary *by construction*: the mean of n′ identical consensus
+replicas is the consensus, which is the old mean.  ``ReshardReport``
+still measures the realized drift (float32 cast rounding) from the
+actual arrays — the same style of check as ``chaos --selftest`` — so
+the invariant is verified on every reshard, not assumed.
+
+Everything here is host-side numpy over msgpack state dicts; no mesh,
+no jax arrays — a supervisor process can reshard a dead run's
+checkpoints without ever touching an accelerator.
+
+Scope: the synchronous push-sum / D-PSGD family.  Overlap checkpoints
+carry in-flight gossip (``gossip/in_flight``) that belongs to a
+specific schedule and cannot be re-attributed across worlds; they are
+rejected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import typing as tp
+
+import numpy as np
+
+__all__ = ["TornCheckpointError", "ReshardReport", "load_world_checkpoint",
+           "consensus_mean", "reshard_state", "reshard_checkpoints",
+           "maybe_cross_world_reshard"]
+
+_CKPT_RE = re.compile(r"^checkpoint_r(\d+)_n(\d+)\.ckpt$")
+
+
+class TornCheckpointError(RuntimeError):
+    """A checkpoint set that does not assemble to its full world —
+    missing rank files or row counts that don't add up (e.g. half the
+    per-process files of a preempted save)."""
+
+
+def _walk(tree: tp.Any, path: tuple = ()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk(v, path + (str(k),))
+    else:
+        yield path, tree
+
+
+def _map_leaves(tree: tp.Any, fn, path: tuple = ()):
+    """Structure-preserving leaf transform (keeps empty dicts, None)."""
+    if isinstance(tree, dict):
+        return {k: _map_leaves(v, fn, path + (str(k),))
+                for k, v in tree.items()}
+    return fn(path, tree)
+
+
+def _rank_files(directory: str, tag: str) -> dict[int, list[tuple[int, str]]]:
+    """``{world: [(rank, path), ...]}`` for every checkpoint set found."""
+    out: dict[int, list[tuple[int, str]]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if tag and not name.startswith(tag):
+            continue
+        m = _CKPT_RE.match(name[len(tag):])
+        if not m:
+            continue
+        rank, world = int(m.group(1)), int(m.group(2))
+        out.setdefault(world, []).append(
+            (rank, os.path.join(directory, name)))
+    for files in out.values():
+        files.sort()
+    return out
+
+
+def load_world_checkpoint(directory: str, tag: str, world: int
+                          ) -> tuple[dict, dict, list[str]]:
+    """Assemble the full ``[world, ...]``-stacked state for one world.
+
+    Reads every ``{tag}checkpoint_r*_n{world}.ckpt`` file, concatenates
+    their rank rows in file-rank order, and verifies the rows sum to
+    ``world`` — a torn set (a rank file missing, or a file whose rows
+    don't fit) raises :class:`TornCheckpointError` instead of silently
+    producing a short world.  Returns ``(state_dict, meta, paths)``
+    where ``meta`` is the newest file's metadata.
+    """
+    import flax.serialization
+
+    files = _rank_files(directory, tag).get(world, [])
+    if not files:
+        raise TornCheckpointError(
+            f"no {tag}checkpoint_r*_n{world}.ckpt under {directory}")
+    states, metas = [], []
+    for _, path in files:
+        with open(path, "rb") as f:
+            raw = flax.serialization.msgpack_restore(f.read())
+        if not (isinstance(raw, dict) and set(raw) == {"state", "meta"}):
+            raise TornCheckpointError(
+                f"{path}: not an atomic state+meta checkpoint (legacy "
+                "two-file layout is not reshardable)")
+        states.append(raw["state"])
+        metas.append((os.path.getmtime(path), raw["meta"]))
+    rows = [int(_ps_weight(s).shape[0]) for s in states]
+    if sum(rows) != world:
+        raise TornCheckpointError(
+            f"torn checkpoint set for world {world}: files "
+            f"{[os.path.basename(p) for _, p in files]} hold "
+            f"{rows} rank rows (= {sum(rows)}, want {world})")
+    if len(states) == 1:
+        state = states[0]
+    else:
+        ref = states[0]
+        state = _map_leaves(ref, lambda path, leaf: leaf if leaf is None
+                            else np.concatenate(
+                                [_leaf_at(s, path) for s in states], axis=0))
+    return state, max(metas, key=lambda m: m[0])[1], [p for _, p in files]
+
+
+def _leaf_at(tree: dict, path: tuple):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _ps_weight(state: dict) -> np.ndarray:
+    gossip = state.get("gossip")
+    if not isinstance(gossip, dict) or "ps_weight" not in gossip:
+        raise ValueError("state has no gossip/ps_weight leaf; only the "
+                         "gossip TrainState layout is reshardable")
+    return np.asarray(gossip["ps_weight"], np.float64).reshape(-1)
+
+
+def consensus_mean(state: dict) -> dict:
+    """Per-leaf exact consensus of the params subtree, in float64:
+    ``Σ rank rows / Σ ps_weight`` — the quantity the restart boundary
+    must preserve.  Used by the reshard itself, its report, and the
+    selftest's independent before/after comparison."""
+    w_sum = float(_ps_weight(state).sum())
+    return {"/".join(path): np.asarray(leaf, np.float64).sum(0) / w_sum
+            for path, leaf in _walk(state["params"])}
+
+
+def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
+    """Collapse-and-restack a ``[old_world, ...]`` state dict to
+    ``[new_world, ...]``.
+
+    Leaf rules:
+
+    * ``params/*`` — push-sum consensus ``Σ rows / Σ ps_weight``
+      (float64 accumulation, cast back to the leaf dtype), replicated;
+    * ``gossip/ps_weight`` — reset to 1 (the replicas are exact);
+    * ``gossip/phase`` — reset to 0 (the new schedule's phase count may
+      differ from the old one's);
+    * ``gossip/in_flight`` — must be ``None``: overlap in-flight shares
+      belong to a schedule that no longer exists;
+    * other float leaves (momentum traces, BatchNorm statistics) —
+      plain rank mean, replicated (BN stats are rank-local by design;
+      the mean is the canonical merged estimate);
+    * integer leaves (``step``) — row 0, replicated (all rows agree).
+    """
+    if new_world < 1:
+        raise ValueError(f"new_world must be >= 1, got {new_world}")
+    in_flight = state.get("gossip", {}).get("in_flight")
+    if in_flight is not None and in_flight != {}:
+        raise ValueError(
+            "overlap checkpoints carry in-flight gossip that cannot be "
+            "resharded; drain the run synchronously first")
+    w = _ps_weight(state)
+    if w.shape[0] != old_world:
+        raise ValueError(f"state holds {w.shape[0]} rank rows, "
+                         f"expected old_world={old_world}")
+    if not np.all(np.isfinite(w)) or np.any(w <= 0):
+        raise ValueError(f"ps_weight must be finite and positive to "
+                         f"de-bias the consensus; got {w}")
+    w_sum = float(w.sum())
+
+    def restack(row: np.ndarray, dtype) -> np.ndarray:
+        return np.broadcast_to(
+            np.asarray(row, dtype)[None],
+            (new_world,) + np.shape(row)).copy()
+
+    def leaf_fn(path, leaf):
+        if leaf is None:
+            return None
+        arr = np.asarray(leaf)
+        if path == ("gossip", "ps_weight"):
+            return np.ones(new_world, arr.dtype)
+        if path == ("gossip", "phase"):
+            return np.zeros(new_world, arr.dtype)
+        if path and path[0] == "params":
+            row = np.asarray(arr, np.float64).sum(0) / w_sum
+            return restack(row, arr.dtype)
+        if np.issubdtype(arr.dtype, np.floating):
+            return restack(np.asarray(arr, np.float64).mean(0), arr.dtype)
+        return restack(arr[0], arr.dtype)
+
+    return _map_leaves(state, leaf_fn)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardReport:
+    """Provenance of one reshard, stamped into the new checkpoint meta
+    and into the supervisor's ``relaunch`` event."""
+
+    old_world: int
+    new_world: int
+    mean_drift: float        # max |consensus before − after| over leaves
+    ps_mass_err: float       # |Σ old ps_weight / old_world − 1|
+    files_in: tuple[str, ...]
+    files_out: tuple[str, ...]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["files_in"] = [os.path.basename(p) for p in self.files_in]
+        d["files_out"] = [os.path.basename(p) for p in self.files_out]
+        return d
+
+
+def reshard_checkpoints(directory: str, tag: str, old_world: int,
+                        new_world: int, out_rank: int = 0,
+                        out_rows: int | None = None,
+                        plan: dict | None = None,
+                        extra_meta: dict | None = None) -> ReshardReport:
+    """Reshard the ``old_world`` checkpoint set on disk and write the
+    ``new_world`` set.
+
+    Writes one ``{tag}checkpoint_r{out_rank}_n{new_world}.ckpt`` holding
+    ``out_rows`` of the (identical) consensus replicas — the single-
+    process layout by default; on a pod each surviving process calls
+    this with its own ``out_rank``/``out_rows`` (the write is
+    deterministic and atomic, so concurrent callers compose).  Restart
+    metadata (epoch/itr/step counters, best metric) is carried over from
+    the old set; ``plan`` (a fresh ``planner.Plan.to_dict()``) and the
+    reshard provenance are stamped in.  The old-world files are left in
+    place — they are the rollback path.
+    """
+    import flax.serialization
+
+    state, meta, files_in = load_world_checkpoint(directory, tag, old_world)
+    before = consensus_mean(state)
+    w = _ps_weight(state)
+    new_state = reshard_state(state, old_world, new_world)
+    after = consensus_mean(new_state)
+    drift = max((float(np.abs(before[k] - after[k]).max())
+                 for k in before), default=0.0)
+
+    meta = dict(meta)
+    meta.pop("health", None)  # the old world's consensus telemetry
+    report = ReshardReport(
+        old_world=old_world, new_world=new_world,
+        mean_drift=drift,
+        ps_mass_err=abs(float(w.sum()) / old_world - 1.0),
+        files_in=tuple(files_in), files_out=())
+    meta["reshard"] = report.to_dict()
+    if plan is not None:
+        meta["plan"] = plan
+    if extra_meta:
+        meta.update(extra_meta)
+
+    rows = new_world if out_rows is None else int(out_rows)
+    out_state = _map_leaves(
+        new_state, lambda path, leaf: leaf if leaf is None else leaf[:rows])
+    out_path = os.path.join(
+        directory, f"{tag}checkpoint_r{out_rank}_n{new_world}.ckpt")
+    payload = {"state": out_state,
+               "meta": json.loads(json.dumps(meta, default=float))}
+    tmp = out_path + f".tmp.r{out_rank}"
+    with open(tmp, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(payload))
+    os.replace(tmp, out_path)
+    return dataclasses.replace(report, files_out=(out_path,))
+
+
+def maybe_cross_world_reshard(directory: str, tag: str, world: int,
+                              out_rank: int = 0,
+                              out_rows: int | None = None,
+                              log=None) -> ReshardReport | None:
+    """Resume helper for a resized relaunch: when no ``n{world}``
+    checkpoint exists but another world's set does, reshard the newest
+    compatible set into place and return its report (None = nothing
+    usable; torn sets are rejected and skipped).  Called by both run
+    CLIs before deciding to cold-start."""
+    sets = _rank_files(directory, tag)
+    if world in sets:
+        return None  # an exact-world set exists; normal restore wins
+    # newest set first (by the newest file inside each set)
+    by_age = sorted(sets, key=lambda w: max(os.path.getmtime(p)
+                                            for _, p in sets[w]),
+                    reverse=True)
+    for old_world in by_age:
+        try:
+            report = reshard_checkpoints(directory, tag, old_world, world,
+                                         out_rank=out_rank,
+                                         out_rows=out_rows)
+        except (TornCheckpointError, ValueError) as e:
+            if log is not None:
+                log.warning("cross-world resume: world-%d set unusable "
+                            "(%s); trying older sets", old_world, e)
+            continue
+        if log is not None:
+            log.warning(
+                "cross-world resume: resharded checkpoint set n=%d -> "
+                "n=%d (consensus collapse; mean drift %.2e)",
+                old_world, world, report.mean_drift)
+        return report
+    return None

@@ -1,0 +1,444 @@
+"""The run supervisor: launch, watch, drain, reshard, replan, relaunch.
+
+One :class:`Supervisor` owns one training run across its generations.
+Per generation it launches the training CLI as a managed child process,
+tails ``<trace_dir>/events.jsonl`` (the typed registry stream: health
+excursions, recovery events carrying ``suggestion.switch``, watchdog
+stalls, step_stats heartbeats) and feeds the
+:class:`~.policy.SupervisorPolicy`.  When the policy decides, the
+supervisor runs the relaunch cycle:
+
+1. **drain** — SIGUSR1 to the child; the run-layer signal path finishes
+   the in-flight chunk, checkpoints, and exits ``REQUEUE_EXIT_CODE``
+   (the checkpoint barrier: that exit code is only reachable *after*
+   the save landed).  A wedged child (dead collective) is SIGKILLed
+   after ``drain_timeout_s`` — its last epoch-boundary checkpoint is
+   the restart point;
+2. **reshard** — :func:`~.reshard.reshard_checkpoints` collapses the
+   per-rank checkpoints to the exact consensus and re-stacks them at
+   the surviving world size (also run for same-world relaunches: the
+   restart boundary is an exact global average, the planner's own
+   below-floor fallback);
+3. **replan** — ``planner.plan_for`` for the new world under the run's
+   stamped :class:`~..planner.PlanConstraints` (fabric model, fault
+   injection, algorithm — read back from the checkpoint metadata the
+   launch stamped);
+4. **relaunch** — the child argv is rewritten with the new
+   ``--world_size/--topology/--slice_size/--global_avg_every/
+   --mixing_alpha`` flags and ``--resume True``.
+
+The supervisor's own decisions stream to
+``<trace_dir>/supervisor.jsonl`` as typed ``supervisor``/``relaunch``
+events (same envelope as the child's registry; a separate file so the
+tailer never reads back its own writes) — ``scripts/obsreport.py``
+renders them as the restart timeline.
+
+A preemption signal (SIGTERM/SIGUSR1) to the *supervisor* drains the
+child and exits with ``REQUEUE_EXIT_CODE`` itself, so an outer
+scheduler (launch/launch_supervised.sh) can requeue the whole job.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..telemetry import (
+    EVENTS_FILE,
+    JsonlSink,
+    LoggerCompatSink,
+    SUPERVISOR_EVENTS_FILE,
+    TelemetryRegistry,
+)
+from ..utils.checkpoint import REQUEUE_EXIT_CODE
+from ..utils.logging import make_logger
+from .policy import Action, SupervisorPolicy
+from .reshard import TornCheckpointError, reshard_checkpoints
+from .tailer import EventTailer
+
+__all__ = ["ChildSpec", "Supervisor"]
+
+
+# -- child argv handling -----------------------------------------------------
+
+
+def _flag_value(argv, name):
+    for i, a in enumerate(argv):
+        if a == name and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _strip_flag(argv, name):
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == name:
+            skip = True
+            continue
+        if a.startswith(name + "="):
+            continue
+        out.append(a)
+    return out
+
+
+def _set_flag(argv, name, value):
+    return _strip_flag(argv, name) + [name, str(value)]
+
+
+def _truthy(v) -> bool:
+    return str(v) == "True"
+
+
+class ChildSpec:
+    """What the supervisor needs to know about the training command."""
+
+    def __init__(self, argv: list[str], checkpoint_dir: str | None = None,
+                 trace_dir: str | None = None, tag: str | None = None,
+                 world: int | None = None):
+        if not argv:
+            raise ValueError("child command is empty")
+        self.argv = list(argv)
+        self.checkpoint_dir = (checkpoint_dir
+                               or _flag_value(argv, "--checkpoint_dir")
+                               or "./checkpoints")
+        self.trace_dir = trace_dir or _flag_value(argv, "--trace_dir")
+        if not self.trace_dir:
+            raise ValueError("supervision needs a telemetry stream: pass "
+                             "--trace_dir (supervisor flag or child flag)")
+        self.is_lm = any("gossip_lm" in a for a in argv)
+        default_tag = "lm_" if self.is_lm else ""
+        self.tag = tag if tag is not None else (
+            _flag_value(argv, "--tag") or default_tag)
+        w = world if world is not None else _flag_value(argv, "--world_size")
+        if w is None:
+            raise ValueError("supervision needs the world size: pass "
+                             "--world_size in the child command (or the "
+                             "supervisor's --world)")
+        self.world = int(w)
+        # planner-relevant child configuration (used when the stamped
+        # checkpoint plan is missing, e.g. a legacy --graph_type launch)
+        self.all_reduce = _truthy(_flag_value(argv, "--all_reduce"))
+        self.bilat = _truthy(_flag_value(argv, "--bilat"))
+        push_sum = _flag_value(argv, "--push_sum")
+        self.algorithm = ("sgp" if push_sum is None or _truthy(push_sum)
+                          else "dpsgd")
+        self.gossip = not (self.all_reduce or self.bilat)
+        self.overlap = _truthy(_flag_value(argv, "--overlap"))
+        self.faults = bool(_flag_value(argv, "--inject_faults"))
+        self.gap_floor = float(_flag_value(argv, "--gap_floor") or 0.01)
+
+    def build_argv(self, world: int, plan: dict | None,
+                   resume: bool) -> list[str]:
+        """The generation's launch command: managed flags rewritten, the
+        rest of the operator's command preserved verbatim."""
+        argv = _strip_flag(self.argv, "--requeue_command")
+        argv = _set_flag(argv, "--world_size", world)
+        argv = _set_flag(argv, "--trace_dir", self.trace_dir)
+        if resume:
+            # relaunched generations always resume from the resharded
+            # checkpoint; generation 0 keeps the operator's own --resume
+            argv = _set_flag(argv, "--resume", "True")
+        if plan is not None:
+            argv = _set_flag(argv, "--topology", plan["topology"])
+            for name in ("--global_avg_every", "--slice_size",
+                         "--mixing_alpha"):
+                argv = _strip_flag(argv, name)
+            if plan.get("global_avg_every"):
+                argv += ["--global_avg_every",
+                         str(plan["global_avg_every"])]
+            if plan.get("slice_size"):
+                argv += ["--slice_size", str(plan["slice_size"])]
+            if plan.get("alpha") is not None:
+                argv += ["--mixing_alpha", str(plan["alpha"])]
+        return argv
+
+
+# -- supervisor --------------------------------------------------------------
+
+
+class Supervisor:
+    def __init__(self, spec: ChildSpec,
+                 policy: SupervisorPolicy | None = None, *,
+                 poll_interval_s: float = 0.5,
+                 drain_timeout_s: float = 300.0,
+                 stall_timeout_s: float = 0.0,
+                 child_env: dict | None = None,
+                 install_signal_handlers: bool = True,
+                 chaos_kill_after_checkpoint: bool = False,
+                 on_relaunch=None, log=None):
+        self.spec = spec
+        self.policy = policy or SupervisorPolicy(world=spec.world)
+        self.poll_interval_s = poll_interval_s
+        self.drain_timeout_s = drain_timeout_s
+        # > 0: a live child with NO event traffic for this long counts as
+        # a lost heartbeat (hung collective).  Needs an event cadence
+        # (--metrics_every / --health_every) to be meaningful
+        self.stall_timeout_s = stall_timeout_s
+        # the supervisor pins its own platform to CPU; the child must
+        # inherit the environment from BEFORE that (scripts/supervise.py
+        # snapshots it), or a TPU child would come up on CPU
+        self.child_env = dict(child_env if child_env is not None
+                              else os.environ)
+        # mark the child as supervised: the run layer then leaves
+        # requeueing to us instead of running `scontrol requeue` itself
+        self.child_env["SGP_SUPERVISED"] = "1"
+        self._install_handlers = install_signal_handlers
+        # selftest chaos injection: SIGKILL the child once its first
+        # checkpoint lands (simulated rank loss with a restart point)
+        self.chaos_kill_after_checkpoint = chaos_kill_after_checkpoint
+        self.on_relaunch = on_relaunch  # hook(report, plan) — selftest
+        self.log = log or make_logger("supervisor")
+        os.makedirs(spec.trace_dir, exist_ok=True)
+        self.registry = TelemetryRegistry(rank=0, sinks=[
+            JsonlSink(os.path.join(spec.trace_dir,
+                                   SUPERVISOR_EVENTS_FILE)),
+            LoggerCompatSink(self.log)])
+        self.tailer = EventTailer(os.path.join(spec.trace_dir,
+                                               EVENTS_FILE))
+        self._preempted = False
+        self._child: subprocess.Popen | None = None
+
+    # -- signals -----------------------------------------------------------
+
+    def _on_signal(self, signum, frame):
+        self.log.warning("supervisor received %s; draining the child",
+                         signal.Signals(signum).name)
+        self._preempted = True
+
+    # -- event emit --------------------------------------------------------
+
+    def _emit(self, action: str, severity: str = "info", **data):
+        self.registry.emit("supervisor",
+                           {"action": action,
+                            "generation": self.policy.generation,
+                            "world": self.policy.world, **data},
+                           severity=severity)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> int:
+        """Supervise until the run completes, the restart budget is
+        spent, or a preemption signal arrives.  Returns the exit code
+        the launch layer should propagate."""
+        old_handlers = {}
+        if self._install_handlers:
+            for sig in (signal.SIGTERM, signal.SIGUSR1):
+                old_handlers[sig] = signal.signal(sig, self._on_signal)
+        try:
+            return self._run()
+        finally:
+            for sig, h in old_handlers.items():
+                signal.signal(sig, h)
+            self.registry.close()
+
+    def _run(self) -> int:
+        plan: dict | None = None
+        resume = False
+        while True:
+            argv = self.spec.build_argv(self.policy.world, plan, resume)
+            self._emit("launch", reason="initial" if resume is False
+                       else "relaunch")
+            self.log.info("launching generation %d (world %d): %s",
+                          self.policy.generation, self.policy.world,
+                          " ".join(argv))
+            self._child = subprocess.Popen(argv, env=self.child_env)
+            action = self._watch()
+            if action.kind == "complete":
+                self._emit("run-complete", reason=action.reason)
+                return 0
+            if action.kind == "give-up":
+                self._emit("gave-up", severity="error",
+                           reason=action.reason)
+                self._kill_child()
+                return 1
+            if action.kind == "preempt-exit":
+                self._drain_child()
+                self._emit("preempt-exit", severity="warning",
+                           reason=action.reason)
+                return REQUEUE_EXIT_CODE
+            # a relaunch cycle: drain/kill, reshard, replan, go again
+            t_detect = time.time()
+            self._emit("restart-decision", severity="warning",
+                       reason=action.reason, kind=action.kind)
+            if action.kind == "drain-restart":
+                self._drain_child()
+            else:
+                self._kill_child()
+            # discard the dead generation's event tail (a draining child
+            # keeps emitting until its save lands): stale recovery
+            # suggestions must not leak into the next generation's
+            # debounce streak
+            self.tailer.poll()
+            new_world = self.policy.target_world(action.shrink)
+            plan = self._replan(new_world)
+            report = None
+            try:
+                report = reshard_checkpoints(
+                    self.spec.checkpoint_dir, self.spec.tag,
+                    self.policy.world, new_world, plan=plan)
+                self.log.warning(
+                    "resharded checkpoints n=%d -> n=%d "
+                    "(consensus collapse, mean drift %.2e)",
+                    self.policy.world, new_world, report.mean_drift)
+            except (TornCheckpointError, ValueError) as e:
+                self.log.warning(
+                    "no reshardable checkpoint (%s); relaunching cold "
+                    "at world %d", e, new_world)
+            prev_world = self.policy.world
+            self.policy.mark_relaunched(new_world)
+            self.registry.emit("relaunch", {
+                "generation": self.policy.generation,
+                "world": new_world, "prev_world": prev_world,
+                "reason": action.reason,
+                "topology": plan.get("topology") if plan else None,
+                "global_avg_every": (plan.get("global_avg_every")
+                                     if plan else None),
+                "mixing_alpha": plan.get("alpha") if plan else None,
+                "slice_size": plan.get("slice_size") if plan else None,
+                "resharded": report is not None,
+                "mean_drift": (report.mean_drift if report is not None
+                               else None),
+                "time_to_recover_s": round(time.time() - t_detect, 3),
+            }, severity="warning")
+            if self.on_relaunch is not None:
+                self.on_relaunch(report, plan)
+            resume = True
+
+    # -- child management --------------------------------------------------
+
+    def _watch(self) -> Action:
+        """Poll the child and its event stream until an action is due."""
+        child = self._child
+        chaos_armed = self.chaos_kill_after_checkpoint
+        ckpt_path = os.path.join(
+            self.spec.checkpoint_dir,
+            f"{self.spec.tag}checkpoint_r0_n{self.policy.world}.ckpt")
+        launch_t = time.time()
+        last_event_t = launch_t
+        # stall grace is per GENERATION: a relaunched child recompiles
+        # from scratch and must not inherit the previous generation's
+        # "already emitting" status
+        seen_at_launch = self.tailer.events_seen
+        while True:
+            for ev in self.tailer.poll():
+                last_event_t = time.time()
+                act = self.policy.observe(ev)
+                if act is not None:
+                    return act
+            if self._preempted:
+                return Action("preempt-exit",
+                              reason="supervisor received a preemption "
+                                     "signal")
+            if chaos_armed and os.path.isfile(ckpt_path) \
+                    and os.path.getmtime(ckpt_path) >= launch_t:
+                # selftest chaos: the restart point exists — lose a rank
+                self.log.warning("chaos: SIGKILLing child pid %d (first "
+                                 "checkpoint landed)", child.pid)
+                self._emit("chaos-kill", severity="warning",
+                           reason="selftest rank loss injection")
+                child.kill()
+                # one-shot across the supervisor's lifetime, not per
+                # generation: the relaunched child must run to completion
+                chaos_armed = self.chaos_kill_after_checkpoint = False
+            rc = child.poll()
+            if rc is not None:
+                # drain any events flushed right before exit — the final
+                # run_meta may carry the exit reason
+                for ev in self.tailer.poll():
+                    self.policy.observe(ev)
+                return self.policy.on_child_exit(rc)
+            if (self.stall_timeout_s > 0
+                    and time.time() - last_event_t > self.stall_timeout_s
+                    and self.tailer.events_seen > seen_at_launch):
+                return self.policy.on_stale(time.time() - last_event_t)
+            time.sleep(self.poll_interval_s)
+
+    def _drain_child(self) -> int | None:
+        """SIGUSR1 → wait for the checkpoint barrier (the child exits
+        REQUEUE_EXIT_CODE strictly after its save); SIGKILL on timeout."""
+        child = self._child
+        if child is None or child.poll() is not None:
+            return child.poll() if child else None
+        self.log.info("draining child pid %d (SIGUSR1)", child.pid)
+        child.send_signal(signal.SIGUSR1)
+        try:
+            rc = child.wait(timeout=self.drain_timeout_s)
+            if rc != REQUEUE_EXIT_CODE:
+                self.log.warning("drained child exited %d (expected the "
+                                 "requeue code %d)", rc, REQUEUE_EXIT_CODE)
+            return rc
+        except subprocess.TimeoutExpired:
+            self.log.warning(
+                "child did not reach the checkpoint barrier within "
+                "%.0fs; killing it (the last epoch checkpoint is the "
+                "restart point)", self.drain_timeout_s)
+            return self._kill_child()
+
+    def _kill_child(self) -> int | None:
+        child = self._child
+        if child is None:
+            return None
+        if child.poll() is None:
+            child.kill()
+        return child.wait()
+
+    # -- replanning --------------------------------------------------------
+
+    def _stamped_plan(self) -> dict | None:
+        """The plan the run launched with, read back from the newest
+        checkpoint metadata (both CLIs stamp ``meta['plan']``)."""
+        from .reshard import _rank_files
+
+        sets = _rank_files(self.spec.checkpoint_dir, self.spec.tag)
+        paths = [p for files in sets.values() for _, p in files]
+        if not paths:
+            return None
+        import flax.serialization
+
+        newest = max(paths, key=os.path.getmtime)
+        try:
+            with open(newest, "rb") as f:
+                raw = flax.serialization.msgpack_restore(f.read())
+        except (OSError, ValueError):
+            return None
+        if isinstance(raw, dict) and isinstance(raw.get("meta"), dict):
+            return raw["meta"].get("plan")
+        return None
+
+    def _replan(self, world: int) -> dict | None:
+        """A fresh ``planner.plan_for`` for ``world`` under the run's
+        stamped constraints; None for non-gossip children (nothing to
+        plan) or when the planner cannot help."""
+        if not self.spec.gossip:
+            return None
+        from ..planner import InterconnectModel, PlanConstraints, plan_for
+
+        stamped = self._stamped_plan() or {}
+        interconnect = None
+        if stamped.get("interconnect"):
+            interconnect = InterconnectModel.from_dict(
+                stamped["interconnect"])
+        cons = PlanConstraints(
+            floor=float(stamped.get("floor", self.spec.gap_floor)),
+            self_weighted=bool(stamped.get("alpha") is not None),
+            interconnect=interconnect,
+            overlap=self.spec.overlap, faults=self.spec.faults)
+        try:
+            plan = plan_for(world, ppi=stamped.get("ppi"),
+                            algorithm=stamped.get("algorithm",
+                                                  self.spec.algorithm),
+                            constraints=cons)
+        except ValueError as e:
+            self.log.warning("replan failed (%s); relaunching with the "
+                             "child's own flags", e)
+            return None
+        self.log.info("replan for world %d: %s", world, plan.summary())
+        return plan.to_dict()

@@ -42,11 +42,16 @@ __all__ = [
     "LEGACY_PREFIXES", "JsonlSink", "LoggerCompatSink", "MemorySink",
     "CommModel", "CommAccountant", "tree_payload_bytes",
     "allreduce_bytes", "COMM_CATEGORIES",
-    "TRACE_FILE", "EVENTS_FILE",
+    "TRACE_FILE", "EVENTS_FILE", "SUPERVISOR_EVENTS_FILE",
 ]
 
 TRACE_FILE = "trace.json"
 EVENTS_FILE = "events.jsonl"
+# the run supervisor's own event stream (same envelope, kinds
+# supervisor/relaunch).  A separate file, not events.jsonl: the
+# supervisor TAILS events.jsonl while the child appends to it, and must
+# neither race the child's writes nor read back its own
+SUPERVISOR_EVENTS_FILE = "supervisor.jsonl"
 
 
 def _rank_file(name: str, rank: int) -> str:

@@ -43,6 +43,8 @@ EVENT_KINDS = frozenset({
     "step_stats",   # periodic loop stats (loss, step/data time)
     "comm",         # comm-volume accounting snapshot (telemetry.comm)
     "bench",        # benchmark artifact lines (bench.py modes)
+    "supervisor",   # run-supervisor lifecycle decision (supervise/)
+    "relaunch",     # one generation boundary: reshard + replan + respawn
 })
 
 SEVERITIES = ("info", "warning", "error")
@@ -53,6 +55,7 @@ LEGACY_PREFIXES = {
     "plan": "gossip plan",
     "health": "gossip health",
     "recovery": "gossip recovery",
+    "supervisor": "gossip supervisor",
 }
 
 

@@ -31,7 +31,7 @@ from ..parallel.multihost import (
 )
 from ..topology import build_pairing_schedule, build_schedule
 from ..utils import Meter, make_logger
-from ..utils.checkpoint import ClusterManager
+from ..utils.checkpoint import REQUEUE_EXIT_CODE, ClusterManager
 from ..utils.profiling import StepWatchdog
 from .lr import CosineLRSchedule, LRSchedule, ppi_at_epoch
 from .state import init_train_state, sgd
@@ -538,6 +538,10 @@ class Trainer:
 
         want_resume = cfg.resume and self.cluster is not None
         have_ckpt = want_resume and self.cluster.ckpt.exists()
+        if want_resume and not have_ckpt:
+            # a resized relaunch: another world's checkpoint set may be
+            # sitting right there — reshard it instead of cold-starting
+            have_ckpt = self._try_cross_world_resume()
         if want_resume and self.proc_count > 1:
             # decide COLLECTIVELY: a per-process exists() gate would hang
             # the cluster when one process's checkpoint is missing/torn
@@ -629,7 +633,7 @@ class Trainer:
 
             state = self._train_epoch(
                 state, ppi, itr_per_epoch, train_loader, epoch, start_itr,
-                meters)
+                meters, best_prec1, begin_time)
             start_itr = 0
 
             if not cfg.train_fast:
@@ -649,42 +653,25 @@ class Trainer:
                 is_best = prec1 > best_prec1
                 best_prec1 = max(best_prec1, prec1)
                 if self.cluster is not None:
-                    meta = {
-                        "epoch": epoch + 1, "itr": 0,
-                        "best_prec1": float(best_prec1),
-                        "elapsed_time": time.time() - begin_time,
-                        "batch_meter": batch_meter.state_dict(),
-                        "nn_meter": nn_meter.state_dict(),
-                        "data_meter": data_meter.state_dict(),
-                    }
-                    if cfg.plan:
-                        # reproducibility: the launch-time topology plan
-                        # (gap, mixing, averaging period, rationale)
-                        # rides with the state it shaped
-                        meta["plan"] = cfg.plan
-                    if self.monitor is not None \
-                            and self.monitor.last_payload:
-                        # the run's consensus health at save time rides
-                        # with the state it describes
-                        meta["health"] = self.monitor.last_payload
+                    meta = self._ckpt_meta(epoch + 1, 0, best_prec1,
+                                           begin_time, meters)
                     epoch_id = (None if cfg.overwrite_checkpoints else epoch)
-                    # global-state backends (orbax on a pod) take the live
-                    # sharded arrays — every process writes its own shards
-                    # of one logical checkpoint; host-local backends
-                    # (msgpack) take this process's rank rows
-                    save_state = (host_local_slice(state)
-                                  if self.proc_count > 1 and not getattr(
-                                      self.cluster.ckpt,
-                                      "saves_global_state", False)
-                                  else state)
+                    if epoch != cfg.num_epochs - 1 \
+                            and self.cluster.any_rank_signalled():
+                        # a signal that arrived during validation: this
+                        # save will requeue-exit, so the typed exit
+                        # record must be flushed first
+                        self._emit_exit_event(
+                            "preempt-requeue", epoch + 1, 0,
+                            (epoch + 1) * itr_per_epoch)
                     with self.telemetry.span("checkpoint_save",
                                              "checkpoint",
                                              {"epoch": epoch}
                                              if self.telemetry.enabled
                                              else None):
                         self.cluster.save_checkpoint(
-                            save_state, meta, epoch_id=epoch_id,
-                            is_best=is_best,
+                            self._save_state(state), meta,
+                            epoch_id=epoch_id, is_best=is_best,
                             requeue_on_signal=(epoch != cfg.num_epochs
                                                - 1))
 
@@ -702,6 +689,100 @@ class Trainer:
         return (global_state_from_local(self.mesh, self.gossip_axis,
                                         local_state), meta)
 
+    def _try_cross_world_resume(self) -> bool:
+        """No checkpoint for the current world: discover another world's
+        set and reshard it into place (exact-average consensus collapse,
+        supervise/reshard.py) so a resized relaunch resumes instead of
+        silently cold-starting.  Torn sets are rejected by the reshard
+        (assembled rank rows must sum to the source world), and on a pod
+        the existing all-gather barrier in fit() still vetoes a resume
+        any process could not complete."""
+        ckpt = self.cluster.ckpt
+        if not hasattr(ckpt, "discover_worlds"):
+            return False  # backend without flat per-rank files (orbax)
+        if self.local_axis is not None:
+            # hierarchical meshes stack gossip rows per NODE while the
+            # filename world counts devices; the row algebra would lie
+            return False
+        if not ckpt.discover_worlds():
+            return False
+        from ..supervise.reshard import maybe_cross_world_reshard
+
+        report = maybe_cross_world_reshard(
+            ckpt.directory, ckpt.tag, self.world_size,
+            out_rank=self.proc_index, out_rows=len(self.local_ranks),
+            log=self.log)
+        return report is not None and ckpt.exists()
+
+    def _ckpt_meta(self, epoch: int, itr: int, best_prec1, begin_time,
+                   meters) -> dict:
+        """Checkpoint metadata for a resume point at (epoch, itr)."""
+        batch_meter, nn_meter, data_meter = meters
+        meta = {
+            "epoch": epoch, "itr": itr,
+            "best_prec1": float(best_prec1),
+            "elapsed_time": time.time() - begin_time,
+            "batch_meter": batch_meter.state_dict(),
+            "nn_meter": nn_meter.state_dict(),
+            "data_meter": data_meter.state_dict(),
+        }
+        if self.cfg.plan:
+            # reproducibility: the launch-time topology plan (gap,
+            # mixing, averaging period, rationale) rides with the state
+            # it shaped
+            meta["plan"] = self.cfg.plan
+        if self.monitor is not None and self.monitor.last_payload:
+            # the run's consensus health at save time rides with the
+            # state it describes
+            meta["health"] = self.monitor.last_payload
+        return meta
+
+    def _save_state(self, state):
+        """What the checkpoint backend receives: global-state backends
+        (orbax on a pod) take the live sharded arrays — every process
+        writes its own shards of one logical checkpoint; host-local
+        backends (msgpack) take this process's rank rows."""
+        if self.proc_count > 1 and not getattr(
+                self.cluster.ckpt, "saves_global_state", False):
+            return host_local_slice(state)
+        return state
+
+    def _emit_exit_event(self, reason: str, epoch: int, itr: int,
+                         step: int) -> None:
+        """Final ``run_meta`` event with the exit reason — the typed
+        record the supervisor (and obsreport) key the requeue on."""
+        if not self.telemetry.enabled:
+            return
+        self.telemetry.registry.emit("run_meta", {
+            "exit_reason": reason,
+            "signal": (self.cluster.last_signal
+                       if self.cluster is not None else None),
+            "epoch": epoch, "itr": itr,
+            "exit_code": REQUEUE_EXIT_CODE,
+        }, step=step, severity="warning")
+
+    def _preempt_exit(self, state, epoch, itr, itr_per_epoch, meters,
+                      best_prec1, begin_time):
+        """A preemption signal arrived (SIGUSR1/SIGTERM on any rank):
+        the in-flight chunk is done, so checkpoint at (epoch, itr), emit
+        the final run_meta event, and exit with the requeue status the
+        supervisor keys on.  ``save_checkpoint(requeue_on_signal=True)``
+        raises ``SystemExit(REQUEUE_EXIT_CODE)`` after the save lands —
+        the exit code doubles as the checkpoint barrier."""
+        self.log.warning(
+            "preemption signal (%s): checkpointing at epoch %d itr %d "
+            "and exiting %d (requeue me)",
+            self.cluster.last_signal or "peer flag", epoch, itr,
+            REQUEUE_EXIT_CODE)
+        self._emit_exit_event("preempt-requeue", epoch, itr,
+                              epoch * itr_per_epoch + itr)
+        meta = self._ckpt_meta(epoch, itr, best_prec1, begin_time, meters)
+        with self.telemetry.span("checkpoint_save", "checkpoint"):
+            self.cluster.save_checkpoint(self._save_state(state), meta,
+                                         requeue_on_signal=True)
+        # only reachable if the flag vanished between check and save
+        raise SystemExit(REQUEUE_EXIT_CODE)
+
     def _batch_spec(self, scanned: bool) -> P:
         """The train step's batch partition spec (must mirror
         shard_train_step / shard_scanned_train_step)."""
@@ -710,7 +791,7 @@ class Trainer:
         return P(None, axes) if scanned else P(axes)
 
     def _train_epoch(self, state, ppi, itr_per_epoch, loader, epoch,
-                     start_itr, meters):
+                     start_itr, meters, best_prec1=0.0, begin_time=None):
         cfg = self.cfg
         batch_meter, nn_meter, data_meter = meters
         stat_meters = {r: (Meter(ptag="Loss"), Meter(ptag="Prec@1"),
@@ -905,6 +986,15 @@ class Trainer:
                     state, alg, metrics,
                     epoch * itr_per_epoch + i + 1, chunk)
             i += chunk
+            if self.cluster is not None \
+                    and self.cluster.any_rank_signalled():
+                # the in-flight chunk just finished: checkpoint NOW and
+                # exit with the requeue status instead of training to
+                # the epoch boundary under a preemption deadline
+                self._preempt_exit(state, epoch, i + 1, itr_per_epoch,
+                                   meters, best_prec1,
+                                   begin_time if begin_time is not None
+                                   else time.time())
             batch_time = time.time()
 
         self._log_row(epoch, i, meters, stat_meters)

@@ -40,7 +40,13 @@ import numpy as np
 
 from .logging import make_logger
 
-__all__ = ["CheckpointManager", "ClusterManager"]
+__all__ = ["CheckpointManager", "ClusterManager", "REQUEUE_EXIT_CODE"]
+
+# exit status of a run that checkpointed in response to SIGUSR1/SIGTERM
+# and wants to be relaunched (EX_TEMPFAIL: "try again later").  Distinct
+# from 0 (run complete) and from crash codes, so the supervisor
+# (supervise/) and launch scripts can key requeue decisions on it.
+REQUEUE_EXIT_CODE = 75
 
 
 class CheckpointManager:
@@ -87,6 +93,24 @@ class CheckpointManager:
     def exists(self) -> bool:
         return os.path.isfile(self.checkpoint_path)
 
+    def discover_worlds(self) -> list[int]:
+        """World sizes with checkpoint files in this directory (any
+        rank), newest set first, the current world excluded.
+
+        ``exists()``/``restore`` only match the *current* world's
+        filenames, so a relaunch at a resized world used to silently
+        cold-start next to a perfectly usable checkpoint set.  This is
+        the discovery half of cross-world resume; the actual resize is
+        ``supervise.reshard`` (which also rejects torn sets — the
+        assembled rank rows must sum to the old world)."""
+        from ..supervise.reshard import _rank_files
+
+        sets = _rank_files(self.directory, self.tag)
+        sets.pop(self.world_size, None)
+        return sorted(sets, key=lambda w: max(os.path.getmtime(p)
+                                              for _, p in sets[w]),
+                      reverse=True)
+
     def restore(self, state_template) -> tuple[tp.Any, dict]:
         """Restore into the structure of ``state_template``."""
         with open(self.checkpoint_path, "rb") as f:
@@ -117,6 +141,7 @@ class ClusterManager:
         self.rank = rank
         self.requeue_command = requeue_command
         self.signal_received = False
+        self.last_signal: str | None = None
         self.logger = make_logger(rank)
         self._flag_path = os.path.join(
             self.ckpt.directory, f"{self.ckpt.tag}.preempt_flag")
@@ -142,12 +167,20 @@ class ClusterManager:
         self.logger.info("Signal handlers installed")
 
     def _sigterm(self, signum, frame):
-        # SIGTERM is advisory under SLURM preemption; SIGUSR1 does the work
-        # (cluster_manager.py:126-131)
+        # the reference treats SIGTERM as advisory (cluster_manager.py:
+        # 126-131, SIGUSR1 does the work), but schedulers that send only
+        # SIGTERM (k8s, plain `kill`) must still drain through a
+        # checkpoint — both signals now raise the same flag
         self.logger.info("Received SIGTERM")
+        self.last_signal = "SIGTERM"
+        self._raise_flag()
 
     def _sigusr1(self, signum, frame):
         self.logger.info("Received SIGUSR1")
+        self.last_signal = "SIGUSR1"
+        self._raise_flag()
+
+    def _raise_flag(self):
         self.signal_received = True
         try:
             with open(self._flag_path, "w") as f:
@@ -178,6 +211,8 @@ class ClusterManager:
                 if os.system(self.requeue_command):
                     raise RuntimeError("requeue command failed")
                 self.logger.info("New job submitted to the queue")
-            # the flag stays on disk so every peer process also sees it and
-            # exits; the requeued job clears it at ClusterManager init
-            raise SystemExit(0)
+            # the flag stays on disk so every peer process also sees it
+            # and exits; the requeued job clears it at ClusterManager
+            # init.  The distinct status tells the supervisor/launcher
+            # "checkpointed, relaunch me" apart from a clean finish
+            raise SystemExit(REQUEUE_EXIT_CODE)

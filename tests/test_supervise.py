@@ -1,0 +1,701 @@
+"""supervise/: the elastic run supervisor.
+
+Pins the subsystem's contracts: (1) the events.jsonl tailer survives
+everything a live JSONL file does (partial trailing lines, truncation,
+rotation, torn writes) without losing or double-reading events; (2) the
+reshard's restart-boundary invariant — the network parameter mean is
+preserved across any n -> n' resize — against an independent numpy
+oracle; (3) the policy debounce — one transient or flapping re-plan
+suggestion triggers nothing, a sustained one triggers exactly one
+relaunch cycle; (4) the supervisor lifecycle end to end (fast with a
+fake child, the full chaos selftest as a slow test).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import flax.serialization
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.supervise import (
+    EventTailer,
+    SupervisorPolicy,
+    TornCheckpointError,
+    consensus_mean,
+    load_world_checkpoint,
+    maybe_cross_world_reshard,
+    reshard_checkpoints,
+    reshard_state,
+)
+from stochastic_gradient_push_tpu.supervise.supervisor import (
+    ChildSpec,
+    Supervisor,
+)
+from stochastic_gradient_push_tpu.utils.checkpoint import (
+    REQUEUE_EXIT_CODE,
+    CheckpointManager,
+    ClusterManager,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORLD = 8
+
+
+# -- events.jsonl tailer ------------------------------------------------------
+
+
+def _ev(kind="step_stats", **data):
+    return {"v": 1, "kind": kind, "t": 0.0, "rank": 0,
+            "severity": "info", "step": 0, "data": data}
+
+
+class TestEventTailer:
+    def test_missing_file_yields_nothing(self, tmp_path):
+        t = EventTailer(str(tmp_path / "events.jsonl"))
+        assert t.poll() == []
+
+    def test_incremental_reads_no_double_delivery(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        t = EventTailer(str(path))
+        with open(path, "a") as f:
+            f.write(json.dumps(_ev(step=1)) + "\n")
+        assert [e["data"] for e in t.poll()] == [{"step": 1}]
+        assert t.poll() == []  # nothing new
+        with open(path, "a") as f:
+            f.write(json.dumps(_ev(step=2)) + "\n")
+            f.write(json.dumps(_ev(step=3)) + "\n")
+        assert [e["data"]["step"] for e in t.poll()] == [2, 3]
+        assert t.events_seen == 3
+
+    def test_partial_trailing_line_buffered_until_newline(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        t = EventTailer(str(path))
+        line = json.dumps(_ev(step=7))
+        with open(path, "w") as f:
+            f.write(line[:10])  # the OS exposed a write mid-line
+        assert t.poll() == []   # incomplete tail never parsed
+        with open(path, "a") as f:
+            f.write(line[10:] + "\n")
+        out = t.poll()
+        assert len(out) == 1 and out[0]["data"]["step"] == 7
+        assert t.skipped == 0  # buffered, not dropped
+
+    def test_malformed_and_non_dict_lines_skipped(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            f.write('{"torn": \n')            # torn write at a crash
+            f.write('[1, 2, 3]\n')            # valid JSON, not an event
+            f.write(json.dumps(_ev()) + "\n")  # the stream continues
+        t = EventTailer(str(path))
+        assert len(t.poll()) == 1  # one corrupt line doesn't blind us
+        assert t.skipped == 2
+
+    def test_truncation_resets_to_start(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for s in range(5):
+                f.write(json.dumps(_ev(step=s)) + "\n")
+        t = EventTailer(str(path))
+        assert len(t.poll()) == 5
+        with open(path, "w") as f:  # truncate-in-place rewrite
+            f.write(json.dumps(_ev(step=99)) + "\n")
+        assert [e["data"]["step"] for e in t.poll()] == [99]
+
+    def test_rotation_new_inode_resets(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(_ev(step=1)) + "\n")
+        t = EventTailer(str(path))
+        assert len(t.poll()) == 1
+        os.rename(path, tmp_path / "events.jsonl.1")
+        # a relaunched child recreates the file: new inode, same name;
+        # padding makes the new file LONGER than the old read offset so
+        # only the inode check can catch it
+        with open(path, "w") as f:
+            f.write(json.dumps(_ev(step=2, pad="x" * 200)) + "\n")
+        out = t.poll()
+        assert [e["data"]["step"] for e in out] == [2]
+
+    def test_unknown_kinds_pass_through(self, tmp_path):
+        # the registry vocabulary may be newer than this supervisor:
+        # unknown kinds must reach the policy (which ignores them), not
+        # be filtered at the tailer
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(_ev(kind="hologram")) + "\n")
+        t = EventTailer(str(path))
+        out = t.poll()
+        assert len(out) == 1 and out[0]["kind"] == "hologram"
+        assert SupervisorPolicy(world=4).observe(out[0]) is None
+
+
+# -- reshard: the restart-boundary invariant ---------------------------------
+
+
+def _world_state(n=WORLD, seed=0):
+    """A synthetic world-stacked gossip TrainState shaped like what
+    CheckpointManager serializes (multi-leaf params, momentum, push-sum
+    lane, int step)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {
+            "conv": {"kernel": rng.normal(size=(n, 3, 3, 2)
+                                          ).astype(np.float32)},
+            "dense": {"kernel": rng.normal(size=(n, 4, 5)
+                                           ).astype(np.float32),
+                      "bias": rng.normal(size=(n, 5)).astype(np.float32)},
+        },
+        "opt_state": {"momentum": rng.normal(size=(n, 4, 5)
+                                             ).astype(np.float32)},
+        "gossip": {
+            # realistic push-sum weights: positive, mean ~1
+            "ps_weight": rng.uniform(0.5, 1.5, size=n).astype(np.float32),
+            "phase": (np.arange(n) % 3).astype(np.int32),
+            "in_flight": None,
+        },
+        "step": np.full((n,), 17, np.int32),
+    }
+
+
+def _oracle_mean(state):
+    """Independent numpy oracle: per-leaf Σ rank rows / Σ ps_weight."""
+    w = np.asarray(state["gossip"]["ps_weight"], np.float64).sum()
+    out = {}
+    for name, sub in state["params"].items():
+        for leaf, arr in sub.items():
+            out[f"{name}/{leaf}"] = (
+                np.asarray(arr, np.float64).sum(0) / w)
+    return out
+
+
+class TestReshardState:
+    @pytest.mark.parametrize("new_world", [1, WORLD // 2, WORLD - 1])
+    def test_mean_preserved_against_numpy_oracle(self, new_world):
+        state = _world_state()
+        oracle = _oracle_mean(state)
+        new = reshard_state(state, WORLD, new_world)
+        # every new rank row is the consensus, so the new network mean
+        # (uniform: ps_weight is reset to 1) equals the old network mean
+        w = np.asarray(new["gossip"]["ps_weight"], np.float64)
+        np.testing.assert_array_equal(w, np.ones(new_world))
+        for name, sub in new["params"].items():
+            for leaf, arr in sub.items():
+                assert arr.shape == (new_world,) + arr.shape[1:]
+                got = np.asarray(arr, np.float64).sum(0) / w.sum()
+                np.testing.assert_allclose(
+                    got, oracle[f"{name}/{leaf}"], atol=1e-6)
+                # and the rows are identical replicas (exact consensus)
+                for r in range(1, new_world):
+                    np.testing.assert_array_equal(arr[r], arr[0])
+
+    def test_leaf_rules(self):
+        state = _world_state()
+        new = reshard_state(state, WORLD, 4)
+        assert np.all(new["gossip"]["phase"] == 0)   # new schedule
+        assert np.all(new["step"] == 17)             # int: row 0
+        assert new["gossip"]["in_flight"] is None
+        # float non-param leaves: plain rank mean, replicated
+        np.testing.assert_allclose(
+            new["opt_state"]["momentum"][0],
+            np.asarray(state["opt_state"]["momentum"],
+                       np.float64).mean(0).astype(np.float32), atol=1e-6)
+        # dtypes survive the float64 round trip
+        assert new["params"]["dense"]["kernel"].dtype == np.float32
+
+    def test_grow_world_also_works(self):
+        # elasticity is not only shrinking: a recovered rank can rejoin
+        state = _world_state()
+        before = consensus_mean(state)
+        after = consensus_mean(reshard_state(state, WORLD, WORLD + 4))
+        for k in before:
+            np.testing.assert_allclose(after[k], before[k], atol=1e-9)
+
+    def test_overlap_in_flight_rejected(self):
+        state = _world_state()
+        state["gossip"]["in_flight"] = {"params": np.zeros((WORLD, 2))}
+        with pytest.raises(ValueError, match="in-flight"):
+            reshard_state(state, WORLD, 4)
+
+    def test_bad_ps_weight_rejected(self):
+        state = _world_state()
+        state["gossip"]["ps_weight"] = np.zeros(WORLD, np.float32)
+        with pytest.raises(ValueError, match="finite and positive"):
+            reshard_state(state, WORLD, 4)
+
+    def test_world_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="rank rows"):
+            reshard_state(_world_state(), WORLD + 1, 4)
+
+
+def _write_rank_file(directory, tag, rank, world, state, meta=None):
+    payload = {"state": state, "meta": meta or {"epoch": 2, "itr": 0}}
+    path = os.path.join(directory,
+                        f"{tag}checkpoint_r{rank}_n{world}.ckpt")
+    with open(path, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(payload))
+    return path
+
+
+def _slice_rows(state, lo, hi):
+    def rec(t):
+        if isinstance(t, dict):
+            return {k: rec(v) for k, v in t.items()}
+        return None if t is None else np.asarray(t)[lo:hi]
+    return rec(state)
+
+
+class TestCheckpointSets:
+    def test_multi_process_set_assembles_in_rank_order(self, tmp_path):
+        state = _world_state()
+        _write_rank_file(tmp_path, "", 0, WORLD, _slice_rows(state, 0, 4))
+        _write_rank_file(tmp_path, "", 1, WORLD, _slice_rows(state, 4, 8))
+        got, meta, paths = load_world_checkpoint(str(tmp_path), "", WORLD)
+        np.testing.assert_array_equal(
+            got["params"]["dense"]["kernel"],
+            state["params"]["dense"]["kernel"])
+        assert len(paths) == 2
+
+    def test_identical_mtimes_do_not_crash_meta_pick(self, tmp_path):
+        # per-process saves land near-simultaneously: an mtime tie must
+        # not fall through to dict-vs-dict comparison
+        state = _world_state()
+        a = _write_rank_file(tmp_path, "", 0, WORLD,
+                             _slice_rows(state, 0, 4), {"epoch": 1})
+        b = _write_rank_file(tmp_path, "", 1, WORLD,
+                             _slice_rows(state, 4, 8), {"epoch": 2})
+        os.utime(a, (100, 100))
+        os.utime(b, (100, 100))
+        _, meta, _ = load_world_checkpoint(str(tmp_path), "", WORLD)
+        assert meta["epoch"] in (1, 2)
+
+    def test_torn_set_rejected(self, tmp_path):
+        # half the per-process files of a preempted save: rows don't
+        # sum to the world — must raise, never assemble a short world
+        state = _world_state()
+        _write_rank_file(tmp_path, "", 0, WORLD, _slice_rows(state, 0, 4))
+        with pytest.raises(TornCheckpointError, match="torn"):
+            load_world_checkpoint(str(tmp_path), "", WORLD)
+
+    def test_missing_set_rejected(self, tmp_path):
+        with pytest.raises(TornCheckpointError, match="no "):
+            load_world_checkpoint(str(tmp_path), "", WORLD)
+
+    def test_reshard_checkpoints_on_disk(self, tmp_path):
+        state = _world_state()
+        _write_rank_file(tmp_path, "", 0, WORLD, state)
+        before = consensus_mean(state)
+        plan = {"world": 4, "topology": "ring"}
+        report = reshard_checkpoints(str(tmp_path), "", WORLD, 4,
+                                     plan=plan)
+        assert report.mean_drift < 1e-6
+        new, meta, _ = load_world_checkpoint(str(tmp_path), "", 4)
+        after = consensus_mean(new)
+        for k in before:
+            np.testing.assert_allclose(after[k], before[k], atol=1e-6)
+        # provenance + the fresh plan are stamped into the new meta
+        assert meta["reshard"]["old_world"] == WORLD
+        assert meta["reshard"]["new_world"] == 4
+        assert meta["plan"] == plan
+        # the old-world files stay in place — they are the rollback path
+        assert os.path.isfile(
+            tmp_path / f"checkpoint_r0_n{WORLD}.ckpt")
+
+    def test_discover_worlds_newest_compatible_first(self, tmp_path):
+        _write_rank_file(tmp_path, "", 0, 8, _world_state(8))
+        old = _write_rank_file(tmp_path, "", 0, 2, _world_state(2))
+        os.utime(old, (1, 1))  # the world-2 set is ancient
+        cm = CheckpointManager(str(tmp_path), world_size=4)
+        assert cm.discover_worlds() == [8, 2]
+        # the current world is excluded (exists()/restore handle it)
+        cm8 = CheckpointManager(str(tmp_path), world_size=8)
+        assert cm8.discover_worlds() == [2]
+
+    def test_maybe_cross_world_reshard_prefers_exact_set(self, tmp_path):
+        _write_rank_file(tmp_path, "", 0, 4, _world_state(4))
+        assert maybe_cross_world_reshard(str(tmp_path), "", 4) is None
+
+    def test_maybe_cross_world_reshard_resizes_newest(self, tmp_path):
+        state = _world_state()
+        _write_rank_file(tmp_path, "", 0, WORLD, state)
+        report = maybe_cross_world_reshard(str(tmp_path), "", 4)
+        assert report is not None and report.old_world == WORLD
+        assert os.path.isfile(tmp_path / "checkpoint_r0_n4.ckpt")
+
+    def test_maybe_cross_world_reshard_skips_torn_set(self, tmp_path):
+        # newest set is torn -> fall through to the older good one
+        state = _world_state()
+        good = _write_rank_file(tmp_path, "", 0, WORLD, state)
+        os.utime(good, (1, 1))
+        _write_rank_file(tmp_path, "", 0, 16, _slice_rows(state, 0, 4))
+        report = maybe_cross_world_reshard(str(tmp_path), "", 4)
+        assert report is not None and report.old_world == WORLD
+
+
+# -- policy: debounce / cooldown / budget ------------------------------------
+
+
+def _suggest(step, switch=True):
+    return {"kind": "recovery", "severity": "warning",
+            "data": {"step": step, "suggestion": {"switch": switch}}}
+
+
+class TestSupervisorPolicy:
+    def test_single_transient_suggestion_triggers_nothing(self):
+        p = SupervisorPolicy(world=8, replan_count=3,
+                             replan_cooldown_steps=20)
+        assert p.observe(_suggest(10)) is None
+
+    def test_flapping_suggestion_resets_the_streak(self):
+        p = SupervisorPolicy(world=8, replan_count=2,
+                             replan_cooldown_steps=10)
+        assert p.observe(_suggest(10)) is None
+        assert p.observe(_suggest(15, switch=False)) is None  # flap
+        assert p.observe(_suggest(30)) is None   # streak restarted
+        assert p.observe(_suggest(35)) is None   # span 5 < cooldown 10
+        act = p.observe(_suggest(45))            # span 15: sustained
+        assert act is not None and act.kind == "drain-restart"
+
+    def test_count_without_span_is_not_sustained(self):
+        # many events in a burst (same recovery cycle) are one signal
+        p = SupervisorPolicy(world=8, replan_count=3,
+                             replan_cooldown_steps=20)
+        for _ in range(5):
+            assert p.observe(_suggest(100)) is None
+
+    def test_sustained_suggestion_fires_exactly_once(self):
+        p = SupervisorPolicy(world=8, replan_count=2,
+                             replan_cooldown_steps=5)
+        p.observe(_suggest(0))
+        act = p.observe(_suggest(10))
+        assert act is not None and act.kind == "drain-restart" \
+            and not act.shrink
+        # the relaunch cycle completes: the pre-restart backlog is gone
+        p.mark_relaunched(8)
+        assert p.observe(_suggest(20)) is None
+        assert p.generation == 1 and p.restarts == 1
+
+    def test_watchdog_stall_means_rank_loss(self):
+        p = SupervisorPolicy(world=8)
+        act = p.observe({"kind": "heartbeat", "severity": "error",
+                         "data": {"stalled_for_s": 120.0}})
+        assert act is not None and act.kind == "restart" and act.shrink
+        # info heartbeats (liveness) are not stalls
+        assert SupervisorPolicy(world=8).observe(
+            {"kind": "heartbeat", "severity": "info", "data": {}}) is None
+
+    def test_event_silence_means_rank_loss(self):
+        act = SupervisorPolicy(world=8).on_stale(61.0)
+        assert act.kind == "restart" and act.shrink
+
+    def test_child_exit_mapping(self):
+        p = SupervisorPolicy(world=8)
+        assert p.on_child_exit(0).kind == "complete"
+        assert p.on_child_exit(REQUEUE_EXIT_CODE).kind == "relaunch"
+        crash = p.on_child_exit(-9)
+        assert crash.kind == "restart" and crash.shrink
+
+    def test_target_world_shrink_floor(self):
+        p = SupervisorPolicy(world=8, shrink_factor=2, min_world=4)
+        assert p.target_world(shrink=False) == 8
+        assert p.target_world(shrink=True) == 4
+        p.mark_relaunched(4)
+        assert p.target_world(shrink=True) == 4  # never below min_world
+
+    def test_restart_budget_gives_up(self):
+        p = SupervisorPolicy(world=8, max_restarts=1)
+        assert p.on_child_exit(-9).kind == "restart"
+        p.mark_relaunched(4)
+        assert p.on_child_exit(-9).kind == "give-up"
+
+    def test_unlimited_budget(self):
+        p = SupervisorPolicy(world=8, max_restarts=0)
+        for _ in range(5):
+            assert p.on_child_exit(-9).kind == "restart"
+            p.mark_relaunched(p.world)
+
+
+# -- child spec / argv handling ----------------------------------------------
+
+
+class TestChildSpec:
+    ARGV = ["python", "-m", "stochastic_gradient_push_tpu.run.gossip_sgd",
+            "--world_size", "8", "--trace_dir", "/runs/t",
+            "--checkpoint_dir", "/ck", "--topology", "ring"]
+
+    def test_flags_parsed(self):
+        spec = ChildSpec(self.ARGV)
+        assert spec.world == 8 and spec.trace_dir == "/runs/t"
+        assert spec.checkpoint_dir == "/ck" and spec.tag == ""
+        assert spec.gossip and spec.algorithm == "sgp"
+
+    def test_lm_child_gets_lm_tag(self):
+        argv = ["python", "-m",
+                "stochastic_gradient_push_tpu.run.gossip_lm",
+                "--world_size", "4", "--trace_dir", "/t"]
+        assert ChildSpec(argv).tag == "lm_"
+
+    def test_trace_dir_and_world_required(self):
+        with pytest.raises(ValueError, match="trace_dir"):
+            ChildSpec(["python", "x.py", "--world_size", "8"])
+        with pytest.raises(ValueError, match="world size"):
+            ChildSpec(["python", "x.py", "--trace_dir", "/t"])
+
+    def test_build_argv_rewrites_managed_flags(self):
+        spec = ChildSpec(self.ARGV)
+        plan = {"topology": "bipartite-exponential", "world": 4,
+                "global_avg_every": 10, "slice_size": None, "alpha": 0.7}
+        argv = spec.build_argv(4, plan, resume=True)
+        joined = " ".join(argv)
+        assert "--world_size 4" in joined
+        assert "--topology bipartite-exponential" in joined
+        assert "--global_avg_every 10" in joined
+        assert "--mixing_alpha 0.7" in joined
+        assert "--slice_size" not in joined
+        assert "--resume True" in joined
+        assert joined.count("--topology") == 1  # the old ring is gone
+        # operator flags the supervisor doesn't manage stay verbatim
+        assert "--checkpoint_dir /ck" in joined
+
+    def test_build_argv_without_plan_keeps_operator_flags(self):
+        argv = ChildSpec(self.ARGV).build_argv(8, None, resume=False)
+        assert "--topology ring" in " ".join(argv)
+        assert "--resume" not in " ".join(argv)
+
+
+# -- supervisor lifecycle (fast, fake child) ---------------------------------
+
+
+FAKE_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+    args = dict(zip(sys.argv[1::2], sys.argv[2::2]))
+    td = args["--trace_dir"]
+    mode_path = os.path.join(td, "mode")
+    mode = open(mode_path).read() if os.path.exists(mode_path) else "done"
+    with open(os.path.join(td, "events.jsonl"), "a") as f:
+        f.write(json.dumps({"v": 1, "kind": "step_stats",
+                            "t": time.time(), "rank": 0,
+                            "severity": "info", "step": 1,
+                            "data": {}}) + "\\n")
+    if mode == "requeue-once":
+        os.remove(mode_path)
+        sys.exit(75)
+    if mode == "crash-once":
+        os.remove(mode_path)
+        sys.exit(1)
+    sys.exit(0)
+""")
+
+
+def _fake_spec(tmp_path, mode):
+    script = tmp_path / "fake_child.py"
+    script.write_text(FAKE_CHILD)
+    (tmp_path / "mode").write_text(mode)
+    return ChildSpec([sys.executable, str(script),
+                      "--trace_dir", str(tmp_path),
+                      "--checkpoint_dir", str(tmp_path),
+                      "--world_size", "4"])
+
+
+def _sup_events(tmp_path):
+    path = tmp_path / "supervisor.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().splitlines() if l]
+
+
+class TestSupervisorLifecycle:
+    def test_requeue_exit_relaunches_same_world(self, tmp_path):
+        spec = _fake_spec(tmp_path, "requeue-once")
+        sup = Supervisor(spec, SupervisorPolicy(world=4, max_restarts=3),
+                         poll_interval_s=0.05,
+                         install_signal_handlers=False)
+        assert sup.run() == 0
+        rel = [e for e in _sup_events(tmp_path)
+               if e["kind"] == "relaunch"]
+        assert len(rel) == 1
+        d = rel[0]["data"]
+        # a voluntary requeue keeps the world; a fresh plan still rides
+        assert d["world"] == 4 and d["prev_world"] == 4
+        assert d["topology"]  # replanned even without a checkpoint
+        assert d["resharded"] is False  # no checkpoint set to reshard
+
+    def test_crash_shrinks_the_world(self, tmp_path):
+        spec = _fake_spec(tmp_path, "crash-once")
+        sup = Supervisor(spec, SupervisorPolicy(world=4, max_restarts=3,
+                                                shrink_factor=2),
+                         poll_interval_s=0.05,
+                         install_signal_handlers=False)
+        assert sup.run() == 0
+        rel = [e for e in _sup_events(tmp_path)
+               if e["kind"] == "relaunch"]
+        assert len(rel) == 1
+        assert rel[0]["data"]["world"] == 2
+        assert rel[0]["data"]["prev_world"] == 4
+
+    def test_budget_spent_gives_up(self, tmp_path):
+        script = tmp_path / "fake_child.py"
+        script.write_text("import sys; sys.exit(1)\n")
+        spec = ChildSpec([sys.executable, str(script),
+                          "--trace_dir", str(tmp_path),
+                          "--checkpoint_dir", str(tmp_path),
+                          "--world_size", "4"])
+        sup = Supervisor(spec, SupervisorPolicy(world=4, max_restarts=1),
+                         poll_interval_s=0.05,
+                         install_signal_handlers=False)
+        assert sup.run() == 1
+        evs = _sup_events(tmp_path)
+        assert any(e["data"].get("action") == "gave-up" for e in evs
+                   if e["kind"] == "supervisor")
+
+    def test_drain_tail_does_not_leak_into_next_generation(self, tmp_path):
+        # a draining child keeps emitting until its save lands; those
+        # stale recovery suggestions must not seed the next generation's
+        # debounce streak (one fresh suggestion would then relaunch)
+        script = tmp_path / "fake_child.py"
+        script.write_text(textwrap.dedent("""
+            import json, os, signal, sys, time
+            args = dict(zip(sys.argv[1::2], sys.argv[2::2]))
+            td = args["--trace_dir"]
+
+            def emit(step):
+                with open(os.path.join(td, "events.jsonl"), "a") as f:
+                    f.write(json.dumps({
+                        "v": 1, "kind": "recovery", "t": time.time(),
+                        "rank": 0, "severity": "warning", "step": step,
+                        "data": {"step": step,
+                                 "suggestion": {"switch": True}},
+                    }) + "\\n")
+
+            if os.path.exists(os.path.join(td, "gen1")):
+                sys.exit(0)  # the relaunched generation is healthy
+            open(os.path.join(td, "gen1"), "w").close()
+
+            def drain(signum, frame):
+                # two more suggestions flushed during the drain window
+                emit(100)
+                emit(101)
+                sys.exit(75)
+            signal.signal(signal.SIGUSR1, drain)
+            emit(1)
+            emit(2)  # span 1 >= cooldown 0: sustained -> drain-restart
+            for _ in range(200):
+                time.sleep(0.1)
+            sys.exit(3)  # supervisor never drained us: fail loudly
+        """))
+        spec = ChildSpec([sys.executable, str(script),
+                          "--trace_dir", str(tmp_path),
+                          "--checkpoint_dir", str(tmp_path),
+                          "--world_size", "4"])
+        sup = Supervisor(
+            spec, SupervisorPolicy(world=4, replan_count=2,
+                                   replan_cooldown_steps=0,
+                                   max_restarts=3),
+            poll_interval_s=0.05, drain_timeout_s=30.0,
+            install_signal_handlers=False)
+        assert sup.run() == 0
+        rel = [e for e in _sup_events(tmp_path)
+               if e["kind"] == "relaunch"]
+        # exactly one cycle: the drain-window backlog died with gen 0
+        assert len(rel) == 1
+        assert rel[0]["data"]["reason"].startswith("replan-suggestion")
+
+    def test_crash_reshards_an_existing_checkpoint_set(self, tmp_path):
+        state = _world_state(4, seed=3)
+        _write_rank_file(tmp_path, "", 0, 4, state)
+        before = consensus_mean(state)
+        spec = _fake_spec(tmp_path, "crash-once")
+        sup = Supervisor(spec, SupervisorPolicy(world=4, max_restarts=2,
+                                                shrink_factor=2),
+                         poll_interval_s=0.05,
+                         install_signal_handlers=False)
+        assert sup.run() == 0
+        rel = [e for e in _sup_events(tmp_path)
+               if e["kind"] == "relaunch"][0]["data"]
+        assert rel["resharded"] is True and rel["world"] == 2
+        after = consensus_mean(
+            load_world_checkpoint(str(tmp_path), "", 2)[0])
+        for k in before:
+            np.testing.assert_allclose(after[k], before[k], atol=1e-6)
+
+
+# -- run-layer wiring ---------------------------------------------------------
+
+
+class TestRequeueExitCode:
+    def test_cluster_manager_exits_with_requeue_code(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path), world_size=2)
+        cluster = ClusterManager(cm, rank=0, install_handlers=False)
+        cluster._sigusr1(signal.SIGUSR1, None)
+        with pytest.raises(SystemExit) as exc:
+            cluster.save_checkpoint({"x": np.zeros(2)}, {"epoch": 1})
+        assert exc.value.code == REQUEUE_EXIT_CODE
+        assert cluster.last_signal == "SIGUSR1"
+
+    def test_sigterm_also_drains(self, tmp_path):
+        # schedulers that send only SIGTERM (k8s, plain kill) must still
+        # drain through a checkpoint
+        cm = CheckpointManager(str(tmp_path), world_size=2)
+        cluster = ClusterManager(cm, rank=0, install_handlers=False)
+        cluster._sigterm(signal.SIGTERM, None)
+        assert cluster.any_rank_signalled()
+        assert cluster.last_signal == "SIGTERM"
+
+    def test_supervised_child_never_self_requeues(self, monkeypatch):
+        from stochastic_gradient_push_tpu.run.gossip_sgd import (
+            _default_requeue)
+        monkeypatch.setenv("SLURM_JOB_ID", "123")
+        assert _default_requeue() == "scontrol requeue 123"
+        monkeypatch.setenv("SGP_SUPERVISED", "1")
+        assert _default_requeue() is None
+
+
+# -- telemetry kinds ----------------------------------------------------------
+
+
+class TestSupervisorTelemetry:
+    def test_new_kinds_accepted_and_closed(self):
+        from stochastic_gradient_push_tpu.telemetry import (
+            MemorySink, TelemetryRegistry)
+        reg = TelemetryRegistry(rank=0, sinks=[MemorySink()])
+        reg.emit("supervisor", {"action": "launch"})
+        reg.emit("relaunch", {"generation": 1})
+        with pytest.raises(ValueError):
+            reg.emit("resize", {})  # still a closed vocabulary
+
+    def test_compat_sink_renders_legacy_supervisor_line(self, caplog):
+        import logging
+
+        from stochastic_gradient_push_tpu.telemetry import (
+            LoggerCompatSink, TelemetryRegistry)
+        log = logging.getLogger("test_supervise_compat")
+        reg = TelemetryRegistry(rank=0, sinks=[LoggerCompatSink(log)])
+        data = {"action": "launch", "world": 8, "generation": 0}
+        with caplog.at_level(logging.INFO, log.name):
+            reg.emit("supervisor", data)
+            reg.emit("relaunch", {"generation": 1})  # no legacy line
+        lines = [r.message for r in caplog.records]
+        assert lines == ["gossip supervisor: "
+                         + json.dumps(data, sort_keys=True)]
+
+
+# -- the chaos e2e (the CI gate) ---------------------------------------------
+
+
+@pytest.mark.slow
+def test_supervise_selftest_kill_reshard_relaunch(tmp_path, capsys):
+    """World-8 CPU child SIGKILLed after its first checkpoint -> the
+    supervisor detects the rank loss, reshards 8->4, replans, relaunches,
+    and the run completes at world 4 with the parameter mean preserved
+    across the restart boundary."""
+    from stochastic_gradient_push_tpu.supervise.cli import selftest
+
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    assert selftest(keep_dir=str(tmp_path), child_env=env) == 0
+    assert "supervise selftest: OK" in capsys.readouterr().out
