@@ -57,7 +57,11 @@ BENCH_GVA_WORLD/BATCH/STEPS/WARMUP/GA/OUT).  ``--topology NAME``
 times the two-level multi-slice schedule against the AR baseline, and
 the artifact stamps the modeled per-link-class (ICI vs DCN) bytes next
 to the measured milliseconds so the planner's DCN weighting can be
-calibrated against real step time.
+calibrated against real step time.  ``--wire_dtype int8`` (or
+BENCH_GVA_WIRE="f32,int8" plus BENCH_GVA_WIRE_BLOCK / BENCH_GVA_EF)
+adds a wire-codec sweep: the same gossip step timed per codec with the
+modeled ENCODED bytes (int8 scale overhead included) alongside — the
+calibration artifact for the planner's wire-fraction pricing.
 """
 
 import json
@@ -386,9 +390,9 @@ def run_gossip_vs_ar() -> dict:
     from stochastic_gradient_push_tpu.data import synthetic_classification
     from stochastic_gradient_push_tpu.models import TinyCNN
     from stochastic_gradient_push_tpu.parallel import (
-        GOSSIP_AXIS, make_gossip_mesh)
+        GOSSIP_AXIS, get_codec, make_gossip_mesh)
     from stochastic_gradient_push_tpu.telemetry import (
-        CommModel, SpanTracer, tree_payload_bytes)
+        CommModel, SpanTracer, encoded_payload_bytes, tree_payload_bytes)
     from stochastic_gradient_push_tpu.topology import (
         TOPOLOGY_NAMES, build_schedule)
     from stochastic_gradient_push_tpu.train import (
@@ -424,9 +428,10 @@ def run_gossip_vs_ar() -> dict:
     y = labels.reshape(world, batch)
 
     payload = None
+    params_tmpl = None
 
     def timed_ms(label, alg):
-        nonlocal payload
+        nonlocal payload, params_tmpl
         step = build_train_step(model, alg, tx, lr_sched,
                                 itr_per_epoch=100, num_classes=classes)
         fn = shard_train_step(step, mesh)
@@ -437,6 +442,8 @@ def run_gossip_vs_ar() -> dict:
             world)
         if payload is None:
             payload = tree_payload_bytes(st.params, world)
+            params_tmpl = jax.tree.map(
+                lambda a: np.zeros(np.shape(a), a.dtype), st.params)
         m = None
         for _ in range(warmup):
             st, m = fn(st, x, y)
@@ -465,6 +472,47 @@ def run_gossip_vs_ar() -> dict:
         schedule, payload, global_avg_every=ga).totals(steps,
                                                        start=warmup)
     ar_bytes = CommModel.for_allreduce(world, payload).totals(steps)
+
+    # wire-dtype sweep: the same gossip step at each codec, measured ms
+    # next to the MODELED encoded bytes (scale overhead included) so the
+    # planner's wire pricing can be calibrated against step time.
+    # BENCH_GVA_WIRE lists the codecs; BENCH_GVA_EF=0 disables error
+    # feedback on the lossy lanes; BENCH_GVA_WIRE_BLOCK sets the int8
+    # block.
+    wire_list = [w.strip() for w in os.environ.get(
+        "BENCH_GVA_WIRE", "f32").split(",") if w.strip()]
+    wire_block = int(os.environ.get("BENCH_GVA_WIRE_BLOCK", "64"))
+    wire_ef = os.environ.get("BENCH_GVA_EF", "1") == "1"
+    wire_sweep = []
+    for wd in wire_list:
+        codec = get_codec(wd, wire_block)
+        lossy = codec is not None and codec.lossy
+        ef = wire_ef and lossy
+        if wd == "f32":
+            ms = sgp_ms  # the headline lane IS the f32 sweep point
+        else:
+            ms = timed_ms(
+                f"sgp_ga_steps_{wd}",
+                sgp(schedule, GOSSIP_AXIS, global_avg_every=ga,
+                    wire=codec, error_feedback=ef))
+        enc = encoded_payload_bytes(params_tmpl, world, codec)
+        modeled = CommModel.from_schedule(
+            schedule, enc, exact_bytes=payload, global_avg_every=ga,
+            codec=codec, error_feedback=ef).totals(steps, start=warmup)
+        wire_sweep.append({
+            "wire_dtype": wd,
+            **({"wire_block": wire_block} if wd == "int8" else {}),
+            "error_feedback": ef,
+            "step_ms": round(ms, 3),
+            "payload_bytes": enc,
+            "modeled_bytes_per_rank": {
+                "gossip_wire": modeled["gossip_wire"],
+                "gossip_ici": modeled["gossip_ici"],
+                "gossip_dcn": modeled["gossip_dcn"],
+                "global_avg": modeled["global_avg"],
+            },
+        })
+
     out = {
         "metric": "sgp_ga_vs_allreduce_step_ms",
         "value": round(sgp_ms, 3),
@@ -487,6 +535,7 @@ def run_gossip_vs_ar() -> dict:
             "gossip_dcn": sgp_bytes["gossip_dcn"],
             "allreduce": ar_bytes["allreduce"],
         },
+        "wire_sweep": wire_sweep,
     }
     out_path = os.environ.get(
         "BENCH_GVA_OUT",
@@ -499,19 +548,23 @@ def run_gossip_vs_ar() -> dict:
     return out
 
 
-def _gva_topology_arg(argv: list[str]) -> str | None:
-    """``--topology NAME`` / ``--topology=NAME`` from a raw argv (no
-    argparse in the parent — it must stay transparent to child flags).
-    Raises SystemExit on a dangling ``--topology``."""
+def _gva_flag_arg(argv: list[str], flag: str) -> str | None:
+    """``FLAG NAME`` / ``FLAG=NAME`` from a raw argv (no argparse in the
+    parent — it must stay transparent to child flags).  Raises
+    SystemExit on a dangling flag."""
     for i, arg in enumerate(argv):
-        if arg == "--topology":
+        if arg == flag:
             if i + 1 >= len(argv):
-                print("--topology needs a value", file=sys.stderr)
+                print(f"{flag} needs a value", file=sys.stderr)
                 raise SystemExit(2)
             return argv[i + 1]
-        if arg.startswith("--topology="):
+        if arg.startswith(flag + "="):
             return arg.split("=", 1)[1]
     return None
+
+
+def _gva_topology_arg(argv: list[str]) -> str | None:
+    return _gva_flag_arg(argv, "--topology")
 
 
 def gossip_vs_ar_main() -> int:
@@ -524,6 +577,12 @@ def gossip_vs_ar_main() -> int:
     topology = _gva_topology_arg(sys.argv)
     if topology is not None:
         env["BENCH_GVA_TOPOLOGY"] = topology
+    wire = _gva_flag_arg(sys.argv, "--wire_dtype")
+    if wire is not None:
+        # sweep the requested codec against the f32 baseline so the
+        # artifact always carries the payload-reduction ratio
+        env["BENCH_GVA_WIRE"] = ("f32" if wire == "f32"
+                                 else f"f32,{wire}")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (

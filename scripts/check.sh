@@ -25,6 +25,10 @@ echo "== chaos self-check (resilience: faults -> monitor -> recovery) =="
 python scripts/chaos.py --selftest
 
 echo
+echo "== wire self-check (int8 + error-feedback gossip wire) =="
+python scripts/wirecheck.py --selftest
+
+echo
 echo "== obsreport self-check (telemetry: tracer -> events -> report) =="
 python scripts/obsreport.py --selftest
 

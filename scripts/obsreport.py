@@ -323,6 +323,19 @@ def render(report: dict) -> str:
             f"{c.get('gossip_rounds')} gossip rounds, "
             f"{c.get('global_avgs')} scheduled avgs, "
             f"{c.get('recoveries')} recovery avgs):")
+        m = c.get("model") or {}
+        wd = m.get("wire_dtype", "f32")
+        if wd != "f32":
+            # the encoding behind the gossip byte lanes (exact lanes —
+            # global/recovery averages — stay full precision)
+            blk = m.get("wire_block")
+            lines.append(
+                f"   gossip wire: {wd}"
+                + (f" (block {blk})" if blk else "")
+                + (", error feedback on" if m.get("error_feedback")
+                   else "")
+                + f"; exact payload {m.get('exact_bytes'):,} B vs "
+                  f"encoded {m.get('payload_bytes'):,} B")
         for k, v in sorted(by.items()):
             if v:
                 lines.append(f"   {k:>18}: {v:,}")

@@ -65,6 +65,20 @@ class PushSumGossip(GossipAlgorithm):
     copies.  Every launched share is consumed exactly once, so push-sum
     mass conservation is preserved for any staleness.
 
+    ``wire`` (a :class:`~..parallel.wire.WireCodec`) compresses gossip
+    payloads on the ppermute boundary — bf16 or per-block int8; the
+    push-sum weight lane always ships exact f32.  ``error_feedback``
+    adds the per-rank residual accumulator (``GossipState.ef_residual``)
+    that re-injects each round's quantization error into the next send,
+    bounding the compression perturbation (parallel/collectives.py
+    module docstring).  Synchronous mode only; composes with
+    ``gossip_every`` thinning (the residual waits out non-firing steps),
+    with fault injection (dropped edges carry their residual), and with
+    hierarchical schedules (the codec rides the delegate DCN lane; the
+    intra-slice psum stays exact).  The residual deliberately SURVIVES
+    exact global averages: it is sender-local pending correction, and
+    re-injecting it later loses nothing the average computed.
+
     ``global_avg_every`` interleaves an *exact* global average every k-th
     step (periodic global averaging, Chen et al.): after the gossip
     round, ``x ← Σ x / Σ w`` via one allreduce and the push-sum weight
@@ -82,7 +96,7 @@ class PushSumGossip(GossipAlgorithm):
                  overlap: bool = False, track_weight: bool = True,
                  gossip_every: int = 1, comm_dtype=None,
                  staleness: int = 1, global_avg_every: int = 0,
-                 faults=None):
+                 faults=None, wire=None, error_feedback: bool = False):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
@@ -146,22 +160,60 @@ class PushSumGossip(GossipAlgorithm):
                 "global_avg_every is a synchronous-mode knob: averaging "
                 "around in-flight overlap shares would double-count them")
         self.global_avg_every = global_avg_every
-        # wire-compression dtype for gossip payloads (e.g. jnp.bfloat16)
-        self.comm_dtype = comm_dtype
+        # wire codec for gossip payloads (parallel/wire.py); comm_dtype
+        # is the deprecated bf16-only alias — both resolve to one codec,
+        # and a lossless codec compiles to the uncompressed path
+        from ..parallel import wire as wire_mod
+
+        if wire is not None and comm_dtype is not None:
+            raise ValueError("pass either wire (a WireCodec) or the "
+                             "deprecated comm_dtype, not both")
+        if wire is None and comm_dtype is not None:
+            wire = wire_mod.from_comm_dtype(comm_dtype)
+        self.wire = wire
+        self.comm_dtype = comm_dtype  # kept for introspection only
+        # per-rank error-feedback residual accumulators (wire.py module
+        # docstring): quantization error from round t re-injected into
+        # round t+1's send — requires a lossy codec to have any error,
+        # and synchronous mode (an overlap in-flight share would
+        # straddle residual windows the same way it straddles faults)
+        if error_feedback:
+            if wire is None or not wire.lossy:
+                raise ValueError(
+                    "error_feedback needs a lossy wire codec "
+                    "(wire_dtype bf16/int8); exact wires have no "
+                    "quantization error to feed back")
+            if overlap:
+                raise ValueError(
+                    "error_feedback is a synchronous-mode feature: "
+                    "overlap in-flight shares would straddle residual "
+                    "windows")
+            if not track_weight:
+                raise ValueError(
+                    "error_feedback rides the push-sum wire "
+                    "(track_weight=True); the push-pull path carries "
+                    "no residual state")
+        self.error_feedback = bool(error_feedback)
 
     # -- helpers -----------------------------------------------------------
 
     def _zeros_like_params(self, params: Params):
         return jax.tree.map(jnp.zeros_like, params)
 
-    def _mix(self, params, ps_weight, phase, tick=None):
+    def _mix(self, params, ps_weight, phase, tick=None, residual=None):
+        """One wire round; returns ``(params, ps_weight, residual)`` —
+        residual is None unless error feedback is active."""
         if self.track_weight:
-            return collectives.mix_push_sum(
+            out = collectives.mix_push_sum(
                 params, ps_weight, phase, self.schedule, self.axis_name,
-                comm_dtype=self.comm_dtype, faults=self.faults, tick=tick)
+                codec=self.wire, faults=self.faults, tick=tick,
+                ef_residual=residual)
+            if residual is None:
+                return out[0], out[1], None
+            return out
         return (collectives.mix_push_pull(
             params, phase, self.schedule, self.axis_name,
-            comm_dtype=self.comm_dtype), ps_weight)
+            codec=self.wire), ps_weight, None)
 
     def _split_round(self, params, ps_weight, phase):
         """One round split into (local share, incoming share).
@@ -173,7 +225,7 @@ class PushSumGossip(GossipAlgorithm):
         tree = (params, ps_weight)
         mixed = collectives.gossip_round(
             tree, phase, self.schedule, self.axis_name,
-            comm_dtype=self.comm_dtype)
+            codec=self.wire)
         # local share is a cheap rescale; recover incoming by subtraction
         # would lose precision — instead compute local share directly and
         # subtract from the mixed total.
@@ -189,6 +241,11 @@ class PushSumGossip(GossipAlgorithm):
 
     def init(self, params: Params) -> GossipState:
         state = GossipState(phase=jnp.int32(0), ps_weight=jnp.float32(1.0))
+        if self.error_feedback:
+            # pending quantization error starts at zero; the structure
+            # mirrors params (the compressed lanes), never the ps-weight
+            state = state.replace(
+                ef_residual=self._zeros_like_params(params))
         if self.overlap:
             # FIFO of `staleness` (params, weight) slots, each holding one
             # round's incoming share.  A tuple of slots (static pytree
@@ -250,13 +307,16 @@ class PushSumGossip(GossipAlgorithm):
         if not self.overlap:
             if self.gossip_every > 1:
                 return self._thinned_post_step(params, state)
-            params, ps_weight = self._mix(params, state.ps_weight, phase)
+            params, ps_weight, residual = self._mix(
+                params, state.ps_weight, phase,
+                residual=state.ef_residual)
             ps_weight = jnp.reshape(jnp.asarray(ps_weight, jnp.float32),
                                     jnp.shape(state.ps_weight))
             params, ps_weight = self._maybe_global_average(
                 params, ps_weight, phase + 1)
             return params, state.replace(phase=phase + 1,
-                                         ps_weight=ps_weight)
+                                         ps_weight=ps_weight,
+                                         ef_residual=residual)
         # overlap: keep local share now, stash incoming for next pre_step
         (local_p, local_w), incoming = self._split_round(
             params, state.ps_weight, phase)
@@ -272,19 +332,23 @@ class PushSumGossip(GossipAlgorithm):
         rotation = tick // self.gossip_every
 
         def mix_branch(operand):
-            p, w = operand
+            p, w, r = operand
             # faults are indexed by the step clock (tick), not the slower
             # rotation counter — a fault window means wall steps
-            p, w = self._mix(p, w, rotation, tick=tick)
-            return p, jnp.reshape(jnp.asarray(w, jnp.float32),
-                                  jnp.shape(state.ps_weight))
+            p, w, r = self._mix(p, w, rotation, tick=tick, residual=r)
+            return (p, jnp.reshape(jnp.asarray(w, jnp.float32),
+                                   jnp.shape(state.ps_weight)), r)
 
-        params, ps_weight = jax.lax.cond(
-            fire, mix_branch, lambda o: o, (params, state.ps_weight))
+        # on non-firing steps the residual rides through unchanged —
+        # pending error waits for the next wire round
+        params, ps_weight, residual = jax.lax.cond(
+            fire, mix_branch, lambda o: o,
+            (params, state.ps_weight, state.ef_residual))
         params, ps_weight = self._maybe_global_average(
             params, ps_weight, tick + 1)
         return params, state.replace(phase=state.phase + 1,
-                                     ps_weight=ps_weight)
+                                     ps_weight=ps_weight,
+                                     ef_residual=residual)
 
     def global_average(self, params, ps_weight):
         """Exact push-sum consensus NOW: ``x ← Σ params / Σ ps_weight``
@@ -394,11 +458,13 @@ def all_reduce(axis_name: str) -> AllReduce:
 def sgp(schedule: GossipSchedule, axis_name: str,
         overlap: bool = False, gossip_every: int = 1,
         comm_dtype=None, staleness: int = 1,
-        global_avg_every: int = 0, faults=None) -> PushSumGossip:
+        global_avg_every: int = 0, faults=None, wire=None,
+        error_feedback: bool = False) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
                          gossip_every=gossip_every, comm_dtype=comm_dtype,
                          staleness=staleness,
-                         global_avg_every=global_avg_every, faults=faults)
+                         global_avg_every=global_avg_every, faults=faults,
+                         wire=wire, error_feedback=error_feedback)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str,

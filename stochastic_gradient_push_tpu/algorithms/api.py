@@ -47,11 +47,18 @@ class GossipState:
       in_flight: pytree of pending peer contributions (overlap mode), the
         compiled analogue of the gossip thread's receive buffer
         (distributed.py:149-155); ``None`` for synchronous algorithms.
+      ef_residual: params-shaped pytree of pending quantization error
+        (error-feedback wire compression, parallel/wire.py): round t's
+        residual is re-injected into round t+1's send so compression
+        noise stays a bounded perturbation of the network mean instead
+        of a bias.  ``None`` unless the algorithm runs a lossy wire
+        codec with ``error_feedback=True``.
     """
 
     phase: jnp.ndarray
     ps_weight: jnp.ndarray
     in_flight: tp.Any = None
+    ef_residual: tp.Any = None
 
 
 class GossipAlgorithm:

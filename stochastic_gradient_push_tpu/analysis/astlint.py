@@ -75,6 +75,11 @@ _KEY_REFRESHERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data"}
 _TELEMETRY_ATTRS = {"span", "instant", "trace_complete", "emit",
                     "emit_comm"}
 
+# the one module allowed to put dtype casts on the gossip wire (SGPL010):
+# parallel/wire.py owns every encode/decode, so pricing and the compiled
+# cast can never disagree
+_WIRE_CAST_EXEMPT_SUFFIX = "parallel/wire.py"
+
 _SUPPRESS_RE = re.compile(r"#\s*sgplint:\s*disable=([A-Za-z0-9_,\s]+|all)")
 
 # paths (relative, substring match on separators) where SGPL007 does not
@@ -388,7 +393,31 @@ class _Linter(ast.NodeVisitor):
         if self.in_traced():
             self._check_host_effect(node, name)
             self._check_telemetry_emission(node)
+            if name == "jax.lax.ppermute":
+                self._check_wire_cast(node)
         self.generic_visit(node)
+
+    # -- SGPL010: raw wire cast on a ppermute payload ----------------------
+
+    def _check_wire_cast(self, node: ast.Call) -> None:
+        """An ``.astype(...)`` anywhere inside a ppermute's payload
+        expression is an inline wire cast — the single-encode-path
+        invariant says every such cast lives in parallel/wire.py, where
+        pricing (telemetry/comm.py) and error feedback see it too."""
+        if self.relpath.replace("\\", "/").endswith(
+                _WIRE_CAST_EXEMPT_SUFFIX):
+            return
+        if not node.args:
+            return
+        for n in ast.walk(node.args[0]):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "astype":
+                self.add(node, "SGPL010",
+                         "raw .astype() wire cast on a ppermute payload "
+                         "— wire encoding belongs to a parallel/wire.py "
+                         "WireCodec (single-encode-path invariant)")
+                return
 
     # -- SGPL009: telemetry emission in traced code ------------------------
 

@@ -49,6 +49,13 @@ RULES: dict[str, tuple[str, str]] = {
         "emit spans/events from the host loop around the compiled call; "
         "in-graph signals must ride the metrics pytree instead "
         "(resilience/monitor.py health_signals is the pattern)"),
+    "SGPL010": (
+        "raw .astype() wire cast on a ppermute payload outside "
+        "parallel/wire.py (single-encode-path invariant: every byte the "
+        "gossip wire ships goes through a WireCodec, so pricing, "
+        "error feedback, and the compiled cast can never disagree)",
+        "route the payload through a parallel/wire.py WireCodec "
+        "(gossip_round(codec=...)) instead of casting inline"),
     "SGPV101": (
         "gossip phase sub-round is not a permutation (ppermute would drop "
         "or duplicate messages)",

@@ -19,6 +19,15 @@ from .multihost import (
     to_host,
 )
 from .ring_attention import blockwise_attention, ring_attention
+from .wire import (
+    BF16Codec,
+    DEFAULT_WIRE_BLOCK,
+    F32Codec,
+    Int8Codec,
+    WIRE_DTYPES,
+    WireCodec,
+    get_codec,
+)
 from .collectives import (
     allreduce_mean,
     allreduce_sum,
@@ -54,4 +63,11 @@ __all__ = [
     "blockwise_attention",
     "push_sum_average",
     "consensus_error",
+    "WireCodec",
+    "F32Codec",
+    "BF16Codec",
+    "Int8Codec",
+    "get_codec",
+    "WIRE_DTYPES",
+    "DEFAULT_WIRE_BLOCK",
 ]

@@ -20,6 +20,33 @@ Correspondence to the reference:
 * :func:`mix_bilat`     ≙ ``BilatPushPull.mix`` (gossiper.py:278-323),
   in the synchronous perfect-matching formulation
 * :func:`allreduce_mean` ≙ the DDP AllReduce baseline (gossip_sgd.py:179-180)
+
+Wire format: every *real* payload leaf (``size > 1``) crosses the
+``ppermute`` boundary through a :class:`~.wire.WireCodec` — identity,
+bf16 truncation, or per-block int8 (``parallel/wire.py``, the single
+encode path; sgplint SGPL010 bans raw ``astype`` wire casts anywhere
+else).  Scalar leaves — the push-sum weight lane — always ship exact
+f32: quantizing the de-bias divisor buys no bandwidth and breaks the
+mass conservation every consensus guarantee rests on.
+
+Error feedback: with a lossy codec, :func:`gossip_round` optionally
+carries a per-rank residual accumulator mirroring the mixed tree.  Round
+``t`` sends ``Q(wᵢ·x + r)`` (the residual rides the first outgoing
+message), and the new residual is the total quantization error across
+the round's messages — so what every rank has *cumulatively delivered*
+equals what exact mixing would have delivered, up to the current
+(bounded) residual.  Compression noise is therefore a bounded
+perturbation of the network mean, never a bias.  Composition rules:
+
+* zero-weight edges (irregular graphs' passive ranks, hierarchical
+  non-delegates) neither receive the injected residual nor leak it —
+  injection is gated on ``wᵢ > 0``;
+* a fault-dropped edge ships exactly zero (symmetric codecs keep
+  ``Q(0) == 0``), the mixing weight is reabsorbed by the sender as
+  usual, and the pending residual is *carried* to the next round;
+* NaN corruption drills poison the residual along with the payload —
+  the ``ef_residual_rms`` health signal (resilience/monitor.py) makes
+  that visible the same step.
 """
 
 from __future__ import annotations
@@ -31,6 +58,7 @@ from jax import lax
 
 from ..topology.hierarchical import HierarchicalSchedule
 from ..topology.schedule import GossipSchedule
+from . import wire as wire_mod
 
 __all__ = [
     "as_scalar",
@@ -68,34 +96,69 @@ def _rank_weight(table: np.ndarray, axis_name: str):
     return jnp.asarray(table)[lax.axis_index(axis_name)]
 
 
+def _resolve_codec(codec, comm_dtype):
+    """One wire codec from the new (``codec``) and deprecated
+    (``comm_dtype``) knobs; lossless resolves to None (the identity
+    path compiles to exactly the pre-codec HLO)."""
+    if codec is None and comm_dtype is not None:
+        codec = wire_mod.from_comm_dtype(comm_dtype)
+    if codec is not None and not codec.lossy:
+        return None
+    return codec
+
+
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
-              comm_dtype=None, faults=None):
+              comm_dtype=None, faults=None, codec=None):
     """Build the mixing function for one static phase of the schedule.
 
-    ``comm_dtype`` (e.g. ``jnp.bfloat16``) compresses the wire payload:
-    messages are cast down before the ppermute and accumulated back in the
-    leaf dtype — half the ICI traffic for bf16 at a ~1e-3 relative
-    quantization error per round.  The local share always stays full
+    Returns ``mix(tree, tick, residual) -> (out, new_residual)``;
+    ``tick`` is None without faults and ``residual`` is None without
+    error feedback (``new_residual`` is then None too).
+
+    ``codec`` (a :class:`~.wire.WireCodec`; ``comm_dtype`` is the
+    deprecated bf16-only alias) compresses the wire payload: real
+    payload leaves are encoded before the ppermute and decoded back in
+    the leaf dtype at the receiver.  The local share always stays full
     precision, so the push-sum mass error is bounded by the received
-    fraction of each round.
+    fraction of each round; scalar leaves (the push-sum weight) never
+    go through the codec at all.
+
+    ``residual`` enables error feedback (see the module docstring): the
+    pending residual is injected into the first outgoing message of
+    ranks that actually send (``w₀ > 0``), and the returned residual
+    accumulates this round's quantization error — with the carry rule
+    that a dropped or non-sending slot keeps its residual pending.
 
     ``faults`` (a :class:`~..resilience.faults.FaultMasks`) injects
-    deterministic edge failures: the built function then takes
-    ``(tree, tick)`` instead of ``tree``, masks each outgoing message with
-    the plan's keep table at ``tick``, and — mass-conserving semantics —
-    reabsorbs the undelivered mixing weight into the sender's local share
-    so the effective matrix stays column-stochastic (push-sum remains
-    exactly mean-preserving under any fault plan).  NaN corruption
-    poisons real payload leaves only; the push-sum weight lane stays
-    finite so ps-weight telemetry survives the fault.
+    deterministic edge failures: outgoing messages are masked with the
+    plan's keep table at ``tick``, and — mass-conserving semantics —
+    the sender reabsorbs the undelivered mixing weight into its local
+    share so the effective matrix stays column-stochastic (push-sum
+    remains exactly mean-preserving under any fault plan).  NaN
+    corruption poisons real payload leaves only; the push-sum weight
+    lane stays finite so ps-weight telemetry survives the fault.
     """
     lo_table = schedule.self_weight[phase_idx]
     edge_w = schedule.edge_weights[phase_idx]
     perms = schedule.perms[phase_idx]
+    send_codec = _resolve_codec(codec, comm_dtype)
 
-    def mix(tree, tick):
+    def mix(tree, tick, residual):
+        if residual is not None and send_codec is None:
+            raise ValueError("error feedback needs a lossy wire codec "
+                             "(bf16/int8); exact wires have no "
+                             "quantization error to feed back")
         lo = _rank_weight(lo_table, axis_name)
-        out = jax.tree.map(lambda a: a * lo.astype(a.dtype), tree)
+        leaves, treedef = jax.tree.flatten(tree)
+        res_in = (jax.tree.leaves(residual)
+                  if residual is not None else None)
+        if res_in is not None and len(res_in) != len(leaves):
+            raise ValueError(
+                "ef residual tree does not mirror the mixed tree "
+                f"({len(res_in)} vs {len(leaves)} leaves)")
+        # untouched (scalar / exact) leaves carry their residual through
+        err = list(res_in) if res_in is not None else None
+        out = [a * lo.astype(a.dtype) for a in leaves]
         corrupt = (faults.corrupt_at(tick, axis_name)
                    if faults is not None and faults.any_corruption else None)
         for i in range(schedule.peers_per_itr):
@@ -103,9 +166,16 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
             keep = (faults.keep_at(tick, i, axis_name)
                     if faults is not None else None)
             pairs = _perm_pairs(perms[i])
-
-            def send(a):
+            for j, a in enumerate(leaves):
                 msg = a * w_i.astype(a.dtype)
+                # error feedback: the pending residual rides the FIRST
+                # outgoing message — of ranks that actually send (a
+                # zero-weight edge must neither ship nor consume it)
+                inject = (res_in is not None and i == 0 and a.size > 1)
+                if inject:
+                    gate = (w_i > 0).astype(msg.dtype)
+                    r = res_in[j].astype(msg.dtype)
+                    msg = msg + r * gate
                 # corrupt real payloads only (size > 1, like compression):
                 # a poisoned de-bias divisor would blind the very
                 # ps-weight telemetry that detects the fault
@@ -116,79 +186,101 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                     # a dropped edge delivers nothing — `where`, not `*`,
                     # so a dropped+corrupted message is 0, never 0·NaN
                     msg = jnp.where(keep > 0, msg, jnp.zeros_like(msg))
-                # compress real payloads only: scalar leaves (the push-sum
-                # weight) stay full precision — quantizing the de-bias
-                # divisor buys no bandwidth and drifts every parameter
-                if (comm_dtype is not None and msg.dtype != comm_dtype
-                        and msg.size > 1):
-                    wire = lax.ppermute(msg.astype(comm_dtype), axis_name,
-                                        pairs)
-                    return wire.astype(a.dtype)
-                return lax.ppermute(msg, axis_name, pairs)
-
-            recv = jax.tree.map(send, tree)
-            out = jax.tree.map(jnp.add, out, recv)
+                if send_codec is not None and msg.size > 1:
+                    parts = send_codec.encode(msg)
+                    recv = send_codec.decode(
+                        tuple(lax.ppermute(p, axis_name, pairs)
+                              for p in parts), msg)
+                    if res_in is not None:
+                        # quantization error of what was attempted on the
+                        # wire (zero for a dropped edge: Q(0) == 0)
+                        q_err = msg - send_codec.decode(parts, msg)
+                        if inject:
+                            # carry rule: when this rank did not put its
+                            # residual on the wire (w₀ == 0 or the edge
+                            # was dropped) the residual stays pending
+                            attempt = gate * (
+                                keep.astype(msg.dtype) if keep is not None
+                                else jnp.asarray(1.0, msg.dtype))
+                            err[j] = q_err + r * (1.0 - attempt)
+                        else:
+                            err[j] = err[j] + q_err
+                else:
+                    recv = lax.ppermute(msg, axis_name, pairs)
+                out[j] = out[j] + recv
             if keep is not None and faults.reabsorb:
                 # sender reabsorbs the undelivered weight: the effective
                 # column still sums to 1 (mass conservation)
                 drop_w = w_i * (1.0 - keep)
-                out = jax.tree.map(
-                    lambda o, a: o + a * drop_w.astype(a.dtype), out, tree)
-        return out
+                out = [o + a * drop_w.astype(a.dtype)
+                       for o, a in zip(out, leaves)]
+        mixed = jax.tree.unflatten(treedef, out)
+        new_res = (jax.tree.unflatten(jax.tree.structure(residual), err)
+                   if res_in is not None else None)
+        return mixed, new_res
 
-    if faults is None:
-        return lambda tree: mix(tree, None)
-
-    def fn(operand):
-        tree, tick = operand
-        return mix(tree, tick)
-
-    return fn
+    return mix
 
 
 def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
-                   axis_name: str, comm_dtype=None):
+                   axis_name: str, comm_dtype=None, codec=None):
     """One compiled hierarchical round: leader ppermute, then the exact
     intra-slice average as ONE grouped ``psum`` over the slice sub-axis
     (ICI-local; the ``slice_size − 1`` rotate-permutations of the table
     representation collapse into a single collective).  Numerically this
     applies exactly ``W_intra @ W_inter(round)`` — the matrices the
-    verifier checks."""
+    verifier checks.
+
+    The wire codec applies to the *delegate* (inter) lane only — the
+    expensive cross-slice DCN messages.  The intra-slice psum is exact
+    by construction: a grouped collective has no per-message wire to
+    encode, and it is ICI-local anyway — the bytes worth compressing
+    are the DCN ones.  The error-feedback residual likewise lives on
+    the inter lane and stays rank-local (never psum-averaged: it is
+    sender memory, not network mass).
+    """
     inter = _round_fn(hsched.inter_schedule, round_idx, axis_name,
-                      comm_dtype)
+                      comm_dtype, codec=codec)
     groups = [list(g) for g in hsched.slice_groups]
     inv_s = 1.0 / hsched.slice_size
 
-    def mix(tree):
-        t = inter(tree)
-        return jax.tree.map(
+    def mix(tree, tick, residual):
+        t, new_res = inter(tree, tick, residual)
+        t = jax.tree.map(
             lambda a: lax.psum(a * jnp.asarray(inv_s, a.dtype), axis_name,
                                axis_index_groups=groups), t)
+        return t, new_res
 
     return mix
 
 
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
-                 comm_dtype=None, faults=None, tick=None):
+                 comm_dtype=None, faults=None, tick=None, codec=None,
+                 ef_residual=None):
     """One synchronous gossip round over an arbitrary pytree.
 
     Computes ``lo * x + Σ_i ppermute(w_i * x, perm_i(phase))`` — the
     column-stochastic mixing the reference assembles from weighted broadcasts
     (gossiper.py:125-147, 191-215).  ``phase`` is a traced int32 scalar;
     rotation (graph_manager.py:128-133) is a free modulo, not communicator
-    churn.  ``comm_dtype`` compresses the wire payload (see
-    :func:`_round_fn`).
+    churn.  ``codec`` (:mod:`.wire`) compresses the wire payload;
+    ``comm_dtype`` is the deprecated bf16-only alias.
 
     A :class:`~..topology.hierarchical.HierarchicalSchedule` compiles to
     its two-level form: leader ``ppermute`` across slices plus one grouped
     ``psum`` inside each slice per round (see :func:`_hier_round_fn`);
-    ``phase`` then counts *rounds*, each spanning two table phases.
+    ``phase`` then counts *rounds*, each spanning two table phases, and
+    the codec compresses the delegate (DCN) lane only.
 
     ``faults`` applies a compiled fault plan (resilience/faults.py) with
     mass-conserving drop semantics; ``tick`` is the fault-time index (a
     traced step counter, defaults to ``phase`` — they coincide except
     under communication thinning, where the rotation advances slower than
     the step clock).
+
+    ``ef_residual`` (a pytree mirroring ``tree``) enables error feedback
+    with a lossy codec; the call then returns ``(mixed, new_residual)``
+    instead of ``mixed`` (see the module docstring for the semantics).
     """
     if isinstance(schedule, HierarchicalSchedule) and faults is not None:
         # static configuration error: reject before any axis
@@ -197,59 +289,75 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
             "fault injection is not supported on hierarchical "
             "schedules: the intra-slice psum has no per-edge mask "
             "(use a flat topology for fault drills)")
+    if ef_residual is not None and _resolve_codec(codec, comm_dtype) is None:
+        raise ValueError(
+            "error feedback needs a lossy wire codec (bf16/int8); exact "
+            "wires have no quantization error to feed back")
     axis_size = lax.axis_size(axis_name)
     if axis_size != schedule.world_size:
         raise ValueError(
             f"schedule was built for world_size={schedule.world_size} but "
             f"mesh axis '{axis_name}' has size {axis_size}")
     if schedule.world_size == 1:
-        return tree
+        return tree if ef_residual is None else (tree, ef_residual)
+
     if isinstance(schedule, HierarchicalSchedule):
         rounds = schedule.rounds_per_cycle
-        if rounds == 1:
-            return _hier_round_fn(schedule, 0, axis_name, comm_dtype)(tree)
-        branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype)
-                    for q in range(rounds)]
-        return lax.switch(as_scalar(phase) % rounds, branches, tree)
-    if faults is not None:
-        tick = as_scalar(phase if tick is None else tick)
-        operand = (tree, tick)
-        if schedule.num_phases == 1:
-            return _round_fn(schedule, 0, axis_name, comm_dtype,
-                             faults)(operand)
-        branches = [_round_fn(schedule, p, axis_name, comm_dtype, faults)
-                    for p in range(schedule.num_phases)]
-        return lax.switch(as_scalar(phase) % schedule.num_phases, branches,
-                          operand)
-    if schedule.num_phases == 1:
-        return _round_fn(schedule, 0, axis_name, comm_dtype)(tree)
-    branches = [_round_fn(schedule, p, axis_name, comm_dtype)
-                for p in range(schedule.num_phases)]
-    return lax.switch(as_scalar(phase) % schedule.num_phases, branches, tree)
+        branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype,
+                                   codec) for q in range(rounds)]
+        idx = as_scalar(phase) % rounds
+        fault_tick = None
+    else:
+        if faults is not None:
+            fault_tick = as_scalar(phase if tick is None else tick)
+        else:
+            fault_tick = None
+        branches = [_round_fn(schedule, p, axis_name, comm_dtype, faults,
+                              codec) for p in range(schedule.num_phases)]
+        idx = as_scalar(phase) % schedule.num_phases
+
+    operand = (tree, fault_tick, ef_residual)
+    if len(branches) == 1:
+        mixed, new_res = branches[0](*operand)
+    else:
+        mixed, new_res = lax.switch(
+            idx, [lambda op, fn=fn: fn(*op) for fn in branches], operand)
+    return mixed if ef_residual is None else (mixed, new_res)
 
 
 def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
-                 axis_name: str, comm_dtype=None, faults=None, tick=None):
+                 axis_name: str, comm_dtype=None, faults=None, tick=None,
+                 codec=None, ef_residual=None):
     """Push-sum round: jointly mixes parameters and the push-sum weight.
 
     The reference appends the scalar ps-weight to the flat payload only when
     mixing is irregular (gossiper.py:83-85, 131-132); here it always rides
     along as one extra pytree leaf — one scalar lane, zero bookkeeping.
+    The weight lane is ALWAYS exact f32 (wire codecs skip scalar leaves),
+    so mass conservation — and therefore the de-biased consensus value —
+    survives compression and every mass-conserving fault plan.
 
-    Returns ``(mixed_params, mixed_ps_weight)``.  For regular schedules a
-    complete synchronous round maps ``ps_weight == 1 → 1``, which is the
-    algebraic form of the reference's lazy-mixing shortcut
-    (distributed.py:188-191).  Under ``faults`` the ps-weight rides the
-    same masked round, so mass conservation — and therefore the de-biased
-    consensus value — survives every mass-conserving fault plan.
+    Returns ``(mixed_params, mixed_ps_weight)``, or
+    ``(mixed_params, mixed_ps_weight, new_residual)`` when
+    ``ef_residual`` (a params-shaped pytree) enables error feedback.
+    For regular schedules a complete synchronous round maps
+    ``ps_weight == 1 → 1``, which is the algebraic form of the
+    reference's lazy-mixing shortcut (distributed.py:188-191).
     """
-    mixed = gossip_round((params, ps_weight), phase, schedule, axis_name,
-                         comm_dtype=comm_dtype, faults=faults, tick=tick)
-    return mixed
+    tree = (params, ps_weight)
+    if ef_residual is None:
+        return gossip_round(tree, phase, schedule, axis_name,
+                            comm_dtype=comm_dtype, faults=faults,
+                            tick=tick, codec=codec)
+    full_res = (ef_residual, jax.tree.map(jnp.zeros_like, ps_weight))
+    (p, w), (new_res, _) = gossip_round(
+        tree, phase, schedule, axis_name, comm_dtype=comm_dtype,
+        faults=faults, tick=tick, codec=codec, ef_residual=full_res)
+    return p, w, new_res
 
 
 def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str,
-                  comm_dtype=None):
+                  comm_dtype=None, codec=None):
     """Doubly-stochastic (D-PSGD) round.
 
     With uniform mixing on a regular graph the mixing matrix is doubly
@@ -262,7 +370,7 @@ def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str,
         raise ValueError("push-pull requires a regular schedule "
                          "(doubly-stochastic mixing)")
     return gossip_round(params, phase, schedule, axis_name,
-                        comm_dtype=comm_dtype)
+                        comm_dtype=comm_dtype, codec=codec)
 
 
 def mix_bilat(params, phase, pairing: np.ndarray, axis_name: str):

@@ -84,6 +84,11 @@ class PlanConstraints:
     # launch, so hierarchical candidates must not win the ranking
     overlap: bool = False
     faults: bool = False
+    # wire codec config ({"dtype", "block", "error_feedback"},
+    # parallel/wire.py): gossip payload lanes are priced at the encoded
+    # fraction (hierarchical intra-slice exact averages stay full
+    # precision), and the config is stamped into the plan
+    wire: dict | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +116,10 @@ class Plan:
     ranking: tuple[dict, ...] = ()  # top scored candidates, best first
     slice_size: int | None = None   # hierarchical slice decomposition
     interconnect: dict | None = None  # fabric model the plan was priced on
+    # wire codec the run will gossip through ({"dtype", "block",
+    # "error_feedback"}; None = exact f32) — comm_cost above is priced at
+    # this encoding, and the stamp rides into checkpoint metadata
+    wire: dict | None = None
 
     @property
     def graph_class(self):
@@ -147,6 +156,17 @@ class Plan:
         if self.global_avg_every:
             parts.append(f"global_avg_every={self.global_avg_every}")
         return " ".join(parts)
+
+
+def _wire_fraction(wire_cfg: dict | None) -> float:
+    """Encoded-bytes ratio of the configured wire codec (1.0 = exact)."""
+    if not wire_cfg or wire_cfg.get("dtype") in (None, "f32"):
+        return 1.0
+    from ..parallel.wire import DEFAULT_WIRE_BLOCK, get_codec
+
+    return get_codec(wire_cfg["dtype"],
+                     wire_cfg.get("block") or DEFAULT_WIRE_BLOCK
+                     ).wire_fraction()
 
 
 def averaging_period(gap: float, floor: float) -> int:
@@ -228,7 +248,8 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
                    cons.peer_counts or DEFAULT_PEER_COUNTS)
     cands = score_candidates(world, peer_counts, floor=cons.floor,
                              allowed=cons.allowed,
-                             interconnect=cons.interconnect)
+                             interconnect=cons.interconnect,
+                             wire_fraction=_wire_fraction(cons.wire))
     if algorithm == "dpsgd":
         # D-PSGD mixes doubly-stochastically; an irregular schedule (the
         # hierarchical two-level graph) would be rejected by the
@@ -268,6 +289,10 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
         rationale += (f" (priced {best.priced_cost:.1f} on the fabric "
                       f"model: ICI {best.ici_per_efold:.1f} + DCN "
                       f"{best.dcn_per_efold:.1f})")
+    wf = _wire_fraction(cons.wire)
+    if wf != 1.0:
+        rationale += (f"; gossip lanes priced at the "
+                      f"{cons.wire['dtype']} wire ({wf:.3f} of f32)")
     if cons.self_weighted:
         # Candidate.graph_class binds the scored slice decomposition
         graph = best.graph_class(world, peers_per_itr=best.ppi)
@@ -310,7 +335,8 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
                 ranking=tuple(c.to_dict() for c in cands[:8]),
                 slice_size=best.slice_size,
                 interconnect=(cons.interconnect.to_dict()
-                              if cons.interconnect else None))
+                              if cons.interconnect else None),
+                wire=cons.wire)
 
 
 def check_topology(world: int, graph_class, ppi: int = 1,
@@ -319,7 +345,8 @@ def check_topology(world: int, graph_class, ppi: int = 1,
                    self_weighted: bool | float = False,
                    global_avg_every: int | None = None,
                    interconnect: InterconnectModel | None = None,
-                   overlap: bool = False, faults: bool = False) -> Plan:
+                   overlap: bool = False, faults: bool = False,
+                   wire: dict | None = None) -> Plan:
     """Score a user-forced topology and warn if it is below the floor.
 
     The warning is structured (one JSON payload) and names the measured
@@ -336,7 +363,8 @@ def check_topology(world: int, graph_class, ppi: int = 1,
                     comm_cost=0.0, global_avg_every=0, algorithm=algorithm,
                     auto=False, rationale="world < 2: gossip is a no-op")
     cand = evaluate_candidate(graph_class, world, ppi,
-                              interconnect=interconnect)
+                              interconnect=interconnect,
+                              wire_fraction=_wire_fraction(wire))
     if cand is None:
         raise ValueError(f"{name} does not support world={world} with "
                          f"peers_per_itr={ppi}")
@@ -367,7 +395,7 @@ def check_topology(world: int, graph_class, ppi: int = 1,
         alt = plan_for(world, ppi=ppi, algorithm=algorithm,
                        constraints=PlanConstraints(
                            floor=floor, interconnect=interconnect,
-                           overlap=overlap, faults=faults))
+                           overlap=overlap, faults=faults, wire=wire))
         gae = (averaging_period(gap, floor) if global_avg_every is None
                else max(0, global_avg_every))
         payload = {
@@ -398,7 +426,8 @@ def check_topology(world: int, graph_class, ppi: int = 1,
                 auto=False, rationale=rationale, warnings=tuple(warnings),
                 slice_size=cand.slice_size,
                 interconnect=(interconnect.to_dict()
-                              if interconnect else None))
+                              if interconnect else None),
+                wire=wire)
 
 
 def resolve_topology(world: int, *, ppi: int = 1,
@@ -410,6 +439,7 @@ def resolve_topology(world: int, *, ppi: int = 1,
                      global_avg_every: int | None = None,
                      interconnect: InterconnectModel | None = None,
                      overlap: bool = False, faults: bool = False,
+                     wire: dict | None = None,
                      log=None, registry=None) -> Plan:
     """Run-layer entry point: resolve ``--topology``/``--graph_type`` into
     a :class:`Plan`, log it, and emit any warnings.
@@ -428,6 +458,10 @@ def resolve_topology(world: int, *, ppi: int = 1,
       overlap / faults: the run requests overlap mode / fault injection;
         hierarchical schedules reject both at launch, so auto mode
         excludes them from the ranking and forced mode fails fast.
+      wire: the run's wire codec config from --wire_dtype/--wire_block/
+        --error_feedback ({"dtype", "block", "error_feedback"}); gossip
+        lanes are priced at the encoded fraction and the config is
+        stamped into the plan (and from there into checkpoint meta).
       log: optional logger; the plan is logged as one JSON line and each
         warning loudly via ``log.warning``.
       registry: optional telemetry registry; when set, the plan publishes
@@ -439,7 +473,7 @@ def resolve_topology(world: int, *, ppi: int = 1,
                         constraints=PlanConstraints(
                             floor=floor, self_weighted=self_weighted,
                             interconnect=interconnect,
-                            overlap=overlap, faults=faults),
+                            overlap=overlap, faults=faults, wire=wire),
                         global_avg_every=global_avg_every)
     else:
         cls = TOPOLOGY_NAMES[topology] if topology else graph_class
@@ -450,7 +484,7 @@ def resolve_topology(world: int, *, ppi: int = 1,
                               floor=floor, self_weighted=self_weighted,
                               global_avg_every=global_avg_every,
                               interconnect=interconnect,
-                              overlap=overlap, faults=faults)
+                              overlap=overlap, faults=faults, wire=wire)
     if registry is not None:
         # info like the legacy line (plan *warnings* go via log below)
         registry.emit("plan", plan.to_dict(), severity="info")

@@ -172,7 +172,7 @@ def _rounds_per_cycle(schedule) -> int:
     return getattr(schedule, "rounds_per_cycle", schedule.num_phases)
 
 
-def wire_per_round(schedule) -> float:
+def wire_per_round(schedule, wire_fraction: float = 1.0) -> float:
     """Payload-equivalents each rank puts on the wire per gossip round.
 
     Flat schedules send ``peers_per_itr`` full payloads.  Hierarchical
@@ -180,17 +180,23 @@ def wire_per_round(schedule) -> float:
     inter_ppi / world`` per rank on average) plus the intra-slice grouped
     allreduce (``2·(s−1)/s`` payloads per rank, the bandwidth-optimal
     ring cost).
+
+    ``wire_fraction`` is the encoded-bytes/full-precision ratio of the
+    active wire codec (:meth:`~..parallel.wire.WireCodec.wire_fraction`
+    — e.g. 0.266 for int8 at block 64).  It scales the *gossip* payload
+    lanes only: the hierarchical intra-slice exact average never
+    compresses, exactly as the collective layer compiles it.
     """
     if getattr(schedule, "phase_kinds", None) is None:
-        return float(schedule.peers_per_itr)
+        return float(schedule.peers_per_itr) * wire_fraction
     s = schedule.slice_size
     inter = (schedule.num_slices * schedule.dcn_fanout
              * schedule.inter_ppi / schedule.world_size)
-    return inter + 2.0 * (s - 1) / s
+    return inter * wire_fraction + 2.0 * (s - 1) / s
 
 
-def cycle_cost(schedule, model: InterconnectModel
-               ) -> tuple[float, float]:
+def cycle_cost(schedule, model: InterconnectModel,
+               wire_fraction: float = 1.0) -> tuple[float, float]:
     """Per-rank mean priced cost of one full rotation cycle.
 
     Returns ``(ici, dcn)`` in payload-equivalents × link weight.  Every
@@ -204,16 +210,24 @@ def cycle_cost(schedule, model: InterconnectModel
     ``s−1`` permutation sends at torus distance) — which is why flat
     graphs win the ranking on a uniform fabric and hierarchical wins
     only when the fabric says DCN dominates.
+
+    ``wire_fraction`` scales every *gossip message* by the active wire
+    codec's encoded-bytes ratio; intra-slice exact averages (grouped
+    psum) stay full precision, as compiled.
     """
     n = schedule.world_size
     kinds = getattr(schedule, "phase_kinds", None)
     ici = dcn = 0.0
     for p in range(schedule.num_phases):
-        if kinds is not None and kinds[p] == "intra" \
-                and model.slice_size:
+        intra = kinds is not None and kinds[p] == "intra"
+        if intra and model.slice_size:
             s = schedule.slice_size
             ici += model.ici_cost * 2.0 * (s - 1) / s
             continue
+        # intra phases priced as written (no slice structure to fuse
+        # into) still ship EXACT payloads — the compiled grouped psum
+        # never compresses, whatever the gossip codec does
+        frac = 1.0 if intra else wire_fraction
         perms = schedule.perms[p]
         weights = schedule.edge_weights[p]
         for i in range(schedule.peers_per_itr):
@@ -223,7 +237,7 @@ def cycle_cost(schedule, model: InterconnectModel
                 dst = int(perms[i, src])
                 if dst == src:
                     continue
-                cost = model.edge_cost(src, dst, n) / n
+                cost = frac * model.edge_cost(src, dst, n) / n
                 if model.is_cross_slice(src, dst):
                     dcn += cost
                 else:
@@ -245,12 +259,14 @@ def instantiate_graph(graph_class, world: int, ppi: int,
 
 def evaluate_candidate(graph_class, world: int, ppi: int,
                        mixing: MixingStrategy | None = None,
-                       interconnect: InterconnectModel | None = None
-                       ) -> Candidate | None:
+                       interconnect: InterconnectModel | None = None,
+                       wire_fraction: float = 1.0) -> Candidate | None:
     """Score one cell; ``None`` when the generator refuses the
     configuration (odd world for a bipartite graph, ppi beyond the phone
     book, ...).  ``interconnect`` prices the edges (None = uniform
-    fabric, the original ring-hop model)."""
+    fabric, the original ring-hop model); ``wire_fraction`` scales the
+    gossip payload lanes by the active wire codec's encoded-bytes ratio
+    (1.0 = full precision — rankings under the default are unchanged)."""
     model = interconnect or UNIFORM
     try:
         graph = instantiate_graph(graph_class, world, ppi, model)
@@ -264,11 +280,11 @@ def evaluate_candidate(graph_class, world: int, ppi: int,
     rounds, _ = consensus_cost(gap, rpc, ppi)
     if math.isfinite(rounds):
         cycles = rounds / rpc
-        comm = rounds * wire_per_round(schedule)
-        uniform_costs = cycle_cost(schedule, UNIFORM)
+        comm = rounds * wire_per_round(schedule, wire_fraction)
+        uniform_costs = cycle_cost(schedule, UNIFORM, wire_fraction)
         hop_cost = cycles * sum(uniform_costs)
         ici_c, dcn_c = (uniform_costs if model is UNIFORM
-                        else cycle_cost(schedule, model))
+                        else cycle_cost(schedule, model, wire_fraction))
         ici_e, dcn_e = cycles * ici_c, cycles * dcn_c
         priced = ici_e + dcn_e
     else:
@@ -302,8 +318,8 @@ def score_candidates(world: int,
                      peer_counts=DEFAULT_PEER_COUNTS,
                      floor: float = DEFAULT_GAP_FLOOR,
                      allowed=None,
-                     interconnect: InterconnectModel | None = None
-                     ) -> list[Candidate]:
+                     interconnect: InterconnectModel | None = None,
+                     wire_fraction: float = 1.0) -> list[Candidate]:
     """Rank every supported (topology × peers_per_itr) cell for ``world``
     under uniform mixing.
 
@@ -315,6 +331,8 @@ def score_candidates(world: int,
       allowed: optional iterable of topology names restricting the search.
       interconnect: fabric cost model pricing every edge (None = the
         uniform 1-D torus — the original ring-hop ranking).
+      wire_fraction: encoded-bytes ratio of the active wire codec,
+        applied to the gossip payload lanes (1.0 = uncompressed).
 
     Returns candidates sorted best-first: clears-the-floor, then cheapest
     priced consensus under the interconnect model, then largest gap,
@@ -330,7 +348,8 @@ def score_candidates(world: int,
         for ppi in peer_counts:
             c = evaluate_candidate(TOPOLOGY_NAMES[name], world, ppi,
                                    UniformMixing(),
-                                   interconnect=interconnect)
+                                   interconnect=interconnect,
+                                   wire_fraction=wire_fraction)
             if c is not None:
                 cands.append(c)
     cands.sort(key=lambda c: (not c.meets(floor), c.priced_cost, -c.gap,

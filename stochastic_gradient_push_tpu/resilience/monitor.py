@@ -44,11 +44,24 @@ import typing as tp
 from ..utils.meter import PercentileMeter
 
 __all__ = ["health_signals", "HealthMonitor", "HealthReport",
-           "HEALTH_KEYS"]
+           "HEALTH_KEYS", "EF_HEALTH_KEY"]
 
 # every key health_signals emits, in the order the JSONL line reports them
 HEALTH_KEYS = ("consensus_residual", "ps_w_min", "ps_w_max", "ps_mass_err",
                "nonfinite_params", "nonfinite_grads")
+
+# optional: quantization-residual RMS, emitted only by runs whose gossip
+# wire runs error-feedback compression (parallel/wire.py).  Under healthy
+# EF the residual stays bounded at ~one quantization step; sustained
+# growth (or NaN from a corruption drill) means the feedback loop is
+# diverging and the wire should be widened
+EF_HEALTH_KEY = "ef_residual_rms"
+
+# EF residual RMS above this is an excursion: parameters are O(1) and a
+# healthy int8 residual sits 2-3 orders of magnitude below — anything
+# approaching parameter scale means compression error is compounding,
+# not telescoping.  Coarse by design; tune per run via the monitor knob.
+DEFAULT_EF_RESIDUAL_FLOOR = 0.1
 
 DEFAULT_PROBE_SLOTS = 256
 
@@ -75,7 +88,8 @@ def _probe_leaf(params):
 
 
 def health_signals(params, grads, ps_weight, axis_name: str,
-                   probe_slots: int = DEFAULT_PROBE_SLOTS) -> dict:
+                   probe_slots: int = DEFAULT_PROBE_SLOTS,
+                   ef_residual=None) -> dict:
     """In-graph health reductions; call inside the compiled step (within
     shard_map) AFTER ``post_step``.  Returns float32 scalars that are
     identical on every rank (each is a collective over ``axis_name``), so
@@ -109,7 +123,7 @@ def health_signals(params, grads, ps_weight, axis_name: str,
         lax.psum(jnp.sum((probe - center) ** 2), axis_name)
         / (world * slots))
 
-    return {
+    out = {
         "consensus_residual": residual,
         "ps_w_min": lax.pmin(w, axis_name),
         "ps_w_max": lax.pmax(w, axis_name),
@@ -118,6 +132,19 @@ def health_signals(params, grads, ps_weight, axis_name: str,
         "nonfinite_grads": (nonfinite_count(grads)
                             if grads is not None else jnp.float32(0.0)),
     }
+    if ef_residual is not None:
+        # network-wide RMS of the pending error-feedback residual: one
+        # sum-of-squares sweep + one scalar psum.  A NaN here (poisoned
+        # wire under a corruption drill) rides into the same excursion
+        # machinery as every other signal.
+        sq = jnp.float32(0.0)
+        n_el = 0
+        for leaf in jax.tree.leaves(ef_residual):
+            sq = sq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+            n_el += leaf.size
+        out[EF_HEALTH_KEY] = jnp.sqrt(
+            lax.psum(sq, axis_name) / (world * max(1, n_el)))
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -147,13 +174,15 @@ class HealthMonitor:
                  residual_floor: float = 0.01,
                  mass_tol: float = DEFAULT_MASS_TOL,
                  ps_weight_floor: float = DEFAULT_PS_WEIGHT_FLOOR,
-                 log=None, step_window: int = 1024, registry=None):
+                 log=None, step_window: int = 1024, registry=None,
+                 ef_residual_floor: float = DEFAULT_EF_RESIDUAL_FLOOR):
         if health_every < 1:
             raise ValueError("health_every must be >= 1")
         self.health_every = health_every
         self.residual_floor = residual_floor
         self.mass_tol = mass_tol
         self.ps_weight_floor = ps_weight_floor
+        self.ef_residual_floor = ef_residual_floor
         self.log = log
         # telemetry registry (telemetry.TelemetryRegistry): when set, the
         # monitor publishes typed `health` events and the registry's
@@ -185,6 +214,11 @@ class HealthMonitor:
         if sig["nonfinite_grads"] > 0 or \
                 sig["nonfinite_grads"] != sig["nonfinite_grads"]:
             reasons.append("nonfinite-grads")
+        ef = sig.get(EF_HEALTH_KEY)
+        if ef is not None and (ef > self.ef_residual_floor or ef != ef):
+            # quantization residual no longer bounded (or NaN-poisoned):
+            # error feedback is compounding instead of telescoping
+            reasons.append("ef-residual-blowup")
         return tuple(reasons)
 
     def observe(self, step: int, signals: tp.Mapping[str, tp.Any]
@@ -193,9 +227,11 @@ class HealthMonitor:
         recovery policy consumes it).  Logging happens here so every
         emitted line went through the same diagnosis."""
         sig = {k: float(signals[k]) for k in HEALTH_KEYS}
+        if EF_HEALTH_KEY in signals:
+            sig[EF_HEALTH_KEY] = float(signals[EF_HEALTH_KEY])
         reasons = self._diagnose(sig)
         payload = {"step": int(step),
-                   **{k: round(sig[k], 8) for k in HEALTH_KEYS},
+                   **{k: round(sig[k], 8) for k in sig},
                    "residual_floor": self.residual_floor,
                    "step_p50_s": round(self.step_time.p50, 5),
                    "step_p99_s": round(self.step_time.p99, 5)}

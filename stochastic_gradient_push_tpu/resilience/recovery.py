@@ -109,7 +109,8 @@ class RecoveryPolicy:
                  residual_floor: float = 0.01,
                  cooldown_steps: int = 10,
                  max_recoveries: int = 0, log=None, registry=None,
-                 interconnect=None, faults: bool = False):
+                 interconnect=None, faults: bool = False,
+                 wire: dict | None = None):
         self.world = world
         self.ppi = ppi
         self.algorithm = algorithm
@@ -122,6 +123,9 @@ class RecoveryPolicy:
         # topologies the relaunch would reject (hierarchical schedules
         # refuse per-edge fault masks)
         self.faults = faults
+        # the run's wire codec config: re-plan suggestions price gossip
+        # lanes at the encoded fraction the relaunch would actually ship
+        self.wire = wire
         self.residual_floor = residual_floor
         self.cooldown_steps = max(0, cooldown_steps)
         self.max_recoveries = max_recoveries
@@ -146,7 +150,7 @@ class RecoveryPolicy:
         plan = plan_for(self.world, ppi=self.ppi, algorithm=self.algorithm,
                         constraints=PlanConstraints(
                             interconnect=self.interconnect,
-                            faults=self.faults))
+                            faults=self.faults, wire=self.wire))
         return {"topology": plan.topology, "ppi": plan.ppi,
                 "gap": round(plan.gap, 6),
                 "global_avg_every": plan.global_avg_every,

@@ -24,6 +24,8 @@ import os
 import time
 
 from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
+from .gossip_sgd import (add_wire_flags, reject_push_sum_wire_knobs,
+                         resolve_wire_flags, wire_plan_config)
 
 __all__ = ["main", "build_parser"]
 
@@ -92,9 +94,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--peers_per_itr", default=1, type=int)
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step (communication thinning)")
-    p.add_argument("--gossip_comm_dtype", default=None,
-                   choices=[None, "bf16"],
-                   help="compress gossip wire payloads to bf16")
+    add_wire_flags(p)
     # optimization
     p.add_argument("--lr", default=0.5, type=float)
     p.add_argument("--momentum", default=0.9, type=float)
@@ -309,6 +309,7 @@ def main(argv=None):
 
     # resilience/mixing flag validation (same error text as gossip_sgd,
     # fail before any device work)
+    resolve_wire_flags(args)
     args.mixing_alpha = _parse_mixing_alpha(args.mixing_alpha)
     if args.mixing_alpha is not None and (
             sb(args.all_reduce) or not sb(args.push_sum)):
@@ -388,6 +389,7 @@ def main(argv=None):
             global_avg_every=args.global_avg_every,  # None = policy
             interconnect=interconnect,
             overlap=sb(args.overlap), faults=bool(args.inject_faults),
+            wire=wire_plan_config(args),
             log=log, registry=rt.registry)
     elif args.topology is not None and (sb(args.all_reduce)
                                         or sb(args.bilat)):
@@ -541,18 +543,14 @@ def main(argv=None):
         model = TransformerLM(cfg)
 
     if sb(args.all_reduce):
-        if args.gossip_every != 1 or args.gossip_comm_dtype:
-            raise SystemExit(
-                "gossip_every/gossip_comm_dtype are push-sum knobs")
+        reject_push_sum_wire_knobs(args)
         alg = all_reduce(GOSSIP_AXIS)
     elif sb(args.bilat):
         # AD-PSGD (synchronous matching formulation), as in gossip_sgd
         from ..algorithms import adpsgd
         from ..topology import build_pairing_schedule
 
-        if args.gossip_every != 1 or args.gossip_comm_dtype:
-            raise SystemExit(
-                "gossip_every/gossip_comm_dtype are push-sum knobs")
+        reject_push_sum_wire_knobs(args)
         graph = GRAPH_TOPOLOGIES[args.graph_type](
             dp, peers_per_itr=args.peers_per_itr)
         alg = adpsgd(build_pairing_schedule(graph), GOSSIP_AXIS)
@@ -575,15 +573,15 @@ def main(argv=None):
                 schedule, gossip_every=args.gossip_every)
             log.warning("gossip faults: %s", fault_plan.summary())
         if sb(args.push_sum):
-            comm_dtype = (jnp.bfloat16 if args.gossip_comm_dtype == "bf16"
-                          else None)
+            from ..parallel.wire import get_codec
+
             alg = sgp(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
-                      gossip_every=args.gossip_every, comm_dtype=comm_dtype,
+                      gossip_every=args.gossip_every,
+                      wire=get_codec(args.wire_dtype, args.wire_block),
+                      error_feedback=bool(args.error_feedback),
                       global_avg_every=gae, faults=faults)
         else:
-            if args.gossip_every != 1 or args.gossip_comm_dtype:
-                raise SystemExit(
-                    "gossip_every/gossip_comm_dtype are push-sum knobs")
+            reject_push_sum_wire_knobs(args)
             alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
                         global_avg_every=gae, faults=faults)
 
@@ -681,7 +679,9 @@ def main(argv=None):
     # ep/tp/pp shard params on non-leading dims, so the per-rank payload
     # arithmetic would be wrong there (same fence as --health_every)
     if rt.enabled and pp == 1 and ep == 1 and tp == 1:
-        from ..telemetry import CommModel, tree_payload_bytes
+        from ..parallel.wire import get_codec
+        from ..telemetry import (CommModel, encoded_payload_bytes,
+                                 tree_payload_bytes)
 
         exact = tree_payload_bytes(state.params, dp)
         if sb(args.all_reduce):
@@ -689,14 +689,17 @@ def main(argv=None):
         elif sb(args.bilat):
             comm_model = CommModel.for_bilat(dp, exact)
         else:
-            wire = (tree_payload_bytes(state.params, dp, itemsize=2)
-                    if args.gossip_comm_dtype == "bf16" else exact)
+            # price the ENCODED payload (codec dtype + int8 scale lane;
+            # scalar leaves exempt) — what the wire actually ships
+            codec = get_codec(args.wire_dtype, args.wire_block)
+            wire = encoded_payload_bytes(state.params, dp, codec)
             comm_model = CommModel.from_schedule(
                 alg.schedule, wire, exact_bytes=exact,
                 gossip_every=alg.gossip_every,
                 global_avg_every=alg.global_avg_every,
                 faults=alg.faults, ps_weight=sb(args.push_sum),
-                interconnect=interconnect)
+                interconnect=interconnect, codec=codec,
+                error_feedback=bool(args.error_feedback))
         rt.attach_comm(comm_model)
     if rt.enabled:
         rt.registry.emit("run_meta", {
@@ -909,7 +912,8 @@ def main(argv=None):
                 residual_floor=args.residual_floor,
                 cooldown_steps=args.health_every, log=log,
                 registry=rt.registry, interconnect=interconnect,
-                faults=bool(args.inject_faults))
+                faults=bool(args.inject_faults),
+                wire=wire_plan_config(args))
             recovery = make_recovery_fn(alg, mesh)
 
     loss_meter = Meter(ptag="Loss")
@@ -1041,7 +1045,8 @@ def main(argv=None):
                         mh = host_metrics(metrics)
                     prints_done += 1
                     if monitor is not None:
-                        from ..resilience.monitor import HEALTH_KEYS
+                        from ..resilience.monitor import (EF_HEALTH_KEY,
+                                                          HEALTH_KEYS)
 
                         # one sample per fetch window: the window's own
                         # average step time (validation time excluded), NOT
@@ -1057,7 +1062,9 @@ def main(argv=None):
                                     max(0.0, elapsed) / steps_in_window)
                         health_window_start = (now, steps_done, val_time)
                         sig = {k: float(np.asarray(mh[k]).ravel()[0])
-                               for k in HEALTH_KEYS}
+                               for k in HEALTH_KEYS
+                               + ((EF_HEALTH_KEY,)
+                                  if EF_HEALTH_KEY in mh else ())}
                         report = monitor.observe(steps_done, sig)
                         if report.unhealthy and policy is not None:
                             event = policy.assess(report)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
+import sys
 
 from ..topology import GRAPH_TOPOLOGIES, MIXING_STRATEGIES, TOPOLOGY_NAMES
 
@@ -32,6 +33,88 @@ __all__ = ["build_parser", "parse_config", "main"]
 
 def _str_bool(v: str) -> bool:
     return str(v) == "True"
+
+
+def add_wire_flags(p: argparse.ArgumentParser) -> None:
+    """Gossip wire-format flags, shared by both run CLIs (gossip_sgd and
+    gossip_lm): codec selection, int8 block size, error feedback, and
+    the deprecated pre-codec alias."""
+    p.add_argument("--wire_dtype", default=None,
+                   choices=[None, "f32", "bf16", "int8"],
+                   help="gossip wire codec (parallel/wire.py): f32 = "
+                        "exact (default), bf16 halves the payload, int8 "
+                        "is symmetric per-block quantization with f32 "
+                        "scales riding alongside (~3.8x smaller at the "
+                        "default block).  The push-sum weight lane "
+                        "always ships exact f32")
+    p.add_argument("--wire_block", default=64, type=int,
+                   help="int8 codec block size: elements sharing one f32 "
+                        "scale (wire overhead 4/wire_block bytes per "
+                        "element)")
+    p.add_argument("--error_feedback", default="False", type=str,
+                   help="carry per-rank error-feedback residual "
+                        "accumulators: round t's quantization error is "
+                        "re-injected into round t+1's send, so wire "
+                        "compression perturbs the network mean by a "
+                        "bounded amount instead of a bias (needs a "
+                        "lossy --wire_dtype; sync push-sum mode)")
+    p.add_argument("--gossip_comm_dtype", default=None,
+                   choices=[None, "bf16"],
+                   help="DEPRECATED alias for --wire_dtype bf16")
+
+
+def resolve_wire_flags(args) -> None:
+    """Normalize the wire flags in place: fold the deprecated
+    --gossip_comm_dtype alias into --wire_dtype, coerce --error_feedback
+    to bool, and fail fast on inconsistent combinations."""
+    ef = _str_bool(args.error_feedback)
+    if args.gossip_comm_dtype:
+        if args.wire_dtype not in (None, "bf16"):
+            raise SystemExit(
+                "--gossip_comm_dtype is a deprecated alias for "
+                "--wire_dtype bf16 and conflicts with "
+                f"--wire_dtype {args.wire_dtype}")
+        print("warning: --gossip_comm_dtype is deprecated; use "
+              "--wire_dtype bf16", file=sys.stderr)
+        args.wire_dtype = "bf16"
+        args.gossip_comm_dtype = None
+    if args.wire_block < 1:
+        raise SystemExit("--wire_block must be >= 1")
+    if ef and args.wire_dtype not in ("bf16", "int8"):
+        raise SystemExit(
+            "--error_feedback needs a lossy --wire_dtype (bf16/int8): "
+            "an exact wire has no quantization error to feed back")
+    if ef and _str_bool(str(getattr(args, "overlap", "False"))):
+        raise SystemExit(
+            "--error_feedback is a synchronous-mode feature: overlap "
+            "in-flight shares would straddle residual windows")
+    args.error_feedback = ef
+
+
+def reject_push_sum_wire_knobs(args) -> None:
+    """One rejection for every non-push-sum branch (all_reduce, bilat,
+    D-PSGD) of BOTH CLIs: communication thinning and the wire codec tune
+    the push-sum gossip wire, which those modes don't have.  Call after
+    :func:`resolve_wire_flags`."""
+    wire_set = (args.wire_dtype not in (None, "f32")
+                or bool(getattr(args, "gossip_comm_dtype", None))
+                or _str_bool(str(args.error_feedback)))
+    if args.gossip_every != 1 or wire_set:
+        raise SystemExit(
+            "gossip_every/wire_dtype/error_feedback (and the deprecated "
+            "gossip_comm_dtype) are push-sum knobs")
+
+
+def wire_plan_config(args) -> dict | None:
+    """The wire stamp the planner prices on and the plan records
+    ({"dtype", "block", "error_feedback"}; None = exact f32 wire)."""
+    if args.wire_dtype in (None, "f32"):
+        return None
+    cfg = {"dtype": args.wire_dtype}
+    if args.wire_dtype == "int8":
+        cfg["block"] = args.wire_block
+    cfg["error_feedback"] = bool(_str_bool(str(args.error_feedback)))
+    return cfg
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -147,10 +230,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label_smoothing", default=0.0, type=float)
     p.add_argument("--grad_accum", default=1, type=int,
                    help="microbatches accumulated per optimizer step")
-    p.add_argument("--gossip_comm_dtype", default=None,
-                   choices=[None, "bf16"],
-                   help="compress gossip wire payloads to bf16 "
-                        "(half the ICI traffic, bounded quantization error)")
+    add_wire_flags(p)
     p.add_argument("--warmup", default="False", type=str)
     p.add_argument("--seed", default=47, type=int)
     p.add_argument("--resume", default="False", type=str)
@@ -257,6 +337,10 @@ def parse_config(argv=None):
     if 0 not in ppi_schedule:
         raise SystemExit("peers_per_itr_schedule must include epoch 0")
     all_reduce = _str_bool(args.all_reduce)
+    resolve_wire_flags(args)
+    if all_reduce or not _str_bool(args.push_sum):
+        # fail at parse time with the same text as the LM CLI's branches
+        reject_push_sum_wire_knobs(args)
     if all_reduce and args.graph_type != -1:
         raise SystemExit("--all_reduce True requires --graph_type -1")
     if all_reduce and args.topology is not None:
@@ -335,7 +419,9 @@ def parse_config(argv=None):
         cosine_lr=_str_bool(args.cosine_lr),
         label_smoothing=args.label_smoothing,
         grad_accum=args.grad_accum,
-        gossip_comm_dtype=args.gossip_comm_dtype,
+        wire_dtype=args.wire_dtype,
+        wire_block=args.wire_block,
+        error_feedback=bool(args.error_feedback),
         per_rank_csv=_str_bool(args.per_rank_csv),
         heartbeat_timeout=args.heartbeat_timeout,
         global_avg_every=args.global_avg_every or 0,
@@ -405,6 +491,7 @@ def _resolve_plan(cfg, args, gossip_world: int, log, registry=None):
         global_avg_every=args.global_avg_every,  # None = policy decides
         interconnect=interconnect,
         overlap=cfg.overlap, faults=bool(cfg.inject_faults),
+        wire=wire_plan_config(args),
         log=log, registry=registry)
     cfg.graph_class = plan.graph_class
     if plan.alpha is not None:

@@ -172,6 +172,13 @@ def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
       differ from the old one's);
     * ``gossip/in_flight`` — must be ``None``: overlap in-flight shares
       belong to a schedule that no longer exists;
+    * ``gossip/ef_residual`` — reset to zeros at the new world.  The
+      error-feedback residual is *pending* quantization correction, not
+      network mass: the consensus collapse above already averages what
+      every rank actually delivered, so zeroing the residual at the
+      restart boundary preserves that mean exactly — it merely forfeits
+      a correction bounded by one quantization step (the same bounded
+      perturbation as a single compressed round);
     * other float leaves (momentum traces, BatchNorm statistics) —
       plain rank mean, replicated (BN stats are rank-local by design;
       the mean is the canonical merged estimate);
@@ -206,6 +213,10 @@ def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
             return np.ones(new_world, arr.dtype)
         if path == ("gossip", "phase"):
             return np.zeros(new_world, arr.dtype)
+        if path[:2] == ("gossip", "ef_residual"):
+            # pending quantization correction is sender-local memory,
+            # dropped safely at the boundary (see the docstring)
+            return np.zeros((new_world,) + arr.shape[1:], arr.dtype)
         if path and path[0] == "params":
             row = np.asarray(arr, np.float64).sum(0) / w_sum
             return restack(row, arr.dtype)

@@ -430,7 +430,10 @@ class Supervisor:
             floor=float(stamped.get("floor", self.spec.gap_floor)),
             self_weighted=bool(stamped.get("alpha") is not None),
             interconnect=interconnect,
-            overlap=self.spec.overlap, faults=self.spec.faults)
+            overlap=self.spec.overlap, faults=self.spec.faults,
+            # the relaunch gossips through the same wire codec the run
+            # was stamped with — price (and re-stamp) it accordingly
+            wire=stamped.get("wire"))
         try:
             plan = plan_for(world, ppi=stamped.get("ppi"),
                             algorithm=stamped.get("algorithm",

@@ -23,6 +23,7 @@ from .comm import (
     CommAccountant,
     CommModel,
     allreduce_bytes,
+    encoded_payload_bytes,
     tree_payload_bytes,
 )
 from .registry import (
@@ -41,7 +42,7 @@ __all__ = [
     "TelemetryRegistry", "SCHEMA_VERSION", "EVENT_KINDS",
     "LEGACY_PREFIXES", "JsonlSink", "LoggerCompatSink", "MemorySink",
     "CommModel", "CommAccountant", "tree_payload_bytes",
-    "allreduce_bytes", "COMM_CATEGORIES",
+    "encoded_payload_bytes", "allreduce_bytes", "COMM_CATEGORIES",
     "TRACE_FILE", "EVENTS_FILE", "SUPERVISOR_EVENTS_FILE",
 ]
 

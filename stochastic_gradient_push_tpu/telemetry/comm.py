@@ -58,7 +58,8 @@ import dataclasses
 import numpy as np
 
 __all__ = ["CommModel", "CommAccountant", "tree_payload_bytes",
-           "allreduce_bytes", "PS_WEIGHT_BYTES", "COMM_CATEGORIES"]
+           "encoded_payload_bytes", "allreduce_bytes", "PS_WEIGHT_BYTES",
+           "COMM_CATEGORIES"]
 
 # the push-sum weight scalar that rides along with every gossip payload
 PS_WEIGHT_BYTES = 4
@@ -86,6 +87,32 @@ def tree_payload_bytes(params, world: int = 1,
         isz = itemsize if itemsize is not None else np.dtype(
             leaf.dtype).itemsize
         total += size * isz
+    return total
+
+
+def encoded_payload_bytes(params, world: int = 1, codec=None) -> int:
+    """Bytes of one rank's payload *as the wire actually ships it*.
+
+    Prices exactly what the collective layer encodes: leaves with more
+    than one element per rank go through the codec
+    (:meth:`~..parallel.wire.WireCodec.element_bytes` — dtype size plus
+    the int8 per-block scale lane), while scalar leaves stay at their
+    own storage dtype (the collective's ``size > 1`` guard keeps them
+    off the codec).  ``codec=None`` (or the identity codec) degenerates
+    to :func:`tree_payload_bytes` — the uncompressed wire.  This is the
+    fix for the old 4 B/element assumption: lanes must reflect the
+    encoded payload, pinned against hand-counts by tests/test_wire.py.
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        size = int(np.prod(np.shape(leaf))) // max(1, world)
+        isz = np.dtype(leaf.dtype).itemsize
+        if codec is None or size <= 1:
+            total += size * isz
+        else:
+            total += codec.element_bytes(size, isz)
     return total
 
 
@@ -130,6 +157,12 @@ class CommModel:
     # messages + intra-slice grouped allreduce)
     slice_size: int | None = None
     hier: bool = False
+    # wire codec provenance (parallel/wire.py): how payload_bytes was
+    # encoded — stamped into snapshots so obsreport names the format
+    # behind the byte counts
+    wire_dtype: str = "f32"
+    wire_block: int | None = None
+    error_feedback: bool = False
     wire_bytes_per_phase: tuple[int, ...] = ()
     ici_bytes_per_phase: tuple[int, ...] = ()
     dcn_bytes_per_phase: tuple[int, ...] = ()
@@ -142,17 +175,26 @@ class CommModel:
                       exact_bytes: int | None = None,
                       gossip_every: int = 1, global_avg_every: int = 0,
                       faults=None, ps_weight: bool = True,
-                      interconnect=None) -> "CommModel":
+                      interconnect=None, codec=None,
+                      error_feedback: bool = False) -> "CommModel":
         """Model a push-sum/D-PSGD run over ``schedule``.
 
-        ``faults`` is an optional ``resilience.FaultMasks``; its keep
-        table yields the delivered fraction per tick row.  ``ps_weight``
-        False drops the per-message weight scalar (D-PSGD).
-        ``interconnect`` (a planner ``InterconnectModel``) supplies the
-        fabric slice decomposition for the ICI/DCN lane split; without
-        one, a hierarchical schedule's own slices classify and flat
-        schedules stay single-lane ICI.
+        ``payload_bytes`` must already be the ENCODED wire payload
+        (:func:`encoded_payload_bytes`); ``codec`` only stamps the wire
+        format's provenance (dtype/block) into the model so snapshots
+        name the encoding behind the numbers.  ``faults`` is an optional
+        ``resilience.FaultMasks``; its keep table yields the delivered
+        fraction per tick row.  ``ps_weight`` False drops the
+        per-message weight scalar (D-PSGD).  ``interconnect`` (a planner
+        ``InterconnectModel``) supplies the fabric slice decomposition
+        for the ICI/DCN lane split; without one, a hierarchical
+        schedule's own slices classify and flat schedules stay
+        single-lane ICI.  On a hierarchical schedule only the delegate
+        (inter) messages ride the codec — the intra-slice grouped psum
+        is exact, which is exactly how the collective layer compiles it.
         """
+        wire_dtype = getattr(codec, "name", "f32") if codec else "f32"
+        wire_block = getattr(codec, "block", None) if codec else None
         n = schedule.world_size
         payload = int(payload_bytes)
         exact = int(exact_bytes if exact_bytes is not None
@@ -211,6 +253,8 @@ class CommModel:
                        gossip_every=max(1, int(gossip_every)),
                        global_avg_every=max(0, int(global_avg_every)),
                        slice_size=fabric, hier=True,
+                       wire_dtype=wire_dtype, wire_block=wire_block,
+                       error_feedback=bool(error_feedback),
                        wire_bytes_per_phase=tuple(wire_l),
                        ici_bytes_per_phase=tuple(ici_l),
                        dcn_bytes_per_phase=tuple(dcn_l),
@@ -244,6 +288,8 @@ class CommModel:
                    hops_per_phase=tuple(hops),
                    keep_fraction_rows=keep_rows, keep_horizon=horizon,
                    slice_size=fabric,
+                   wire_dtype=wire_dtype, wire_block=wire_block,
+                   error_feedback=bool(error_feedback),
                    wire_bytes_per_phase=tuple(wire_l),
                    ici_bytes_per_phase=tuple(ici_l),
                    dcn_bytes_per_phase=tuple(dcn_l),
@@ -346,6 +392,9 @@ class CommModel:
                 "faulted": bool(self.keep_fraction_rows),
                 "slice_size": self.slice_size,
                 "hierarchical": self.hier,
+                "wire_dtype": self.wire_dtype,
+                "wire_block": self.wire_block,
+                "error_feedback": self.error_feedback,
                 "ici_bytes_per_phase": list(self.ici_bytes_per_phase),
                 "dcn_bytes_per_phase": list(self.dcn_bytes_per_phase)}
 

@@ -421,7 +421,8 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             # meshes only; the CLI enforces that.)
             from ..resilience.monitor import health_signals
             metrics.update(health_signals(
-                params, grads, gstate.ps_weight, health_axis))
+                params, grads, gstate.ps_weight, health_axis,
+                ef_residual=gstate.ef_residual))
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 

@@ -69,8 +69,17 @@ class TrainerConfig:
     # launch-time topology plan (planner.Plan.to_dict()); logged at
     # startup and stamped into checkpoint metadata for reproducibility
     plan: dict | None = None
-    # wire dtype for gossip payloads: None = leaf dtype, "bf16" halves
-    # ICI traffic with bounded quantization error
+    # gossip wire codec (parallel/wire.py): None/"f32" = exact leaf
+    # dtype, "bf16" halves the wire, "int8" is symmetric per-block
+    # quantization at wire_block elements per f32 scale (~3.8x smaller)
+    wire_dtype: str | None = None
+    wire_block: int = 64
+    # per-rank error-feedback residual accumulators: re-inject round t's
+    # quantization error into round t+1's send so compression noise is a
+    # bounded perturbation, not a bias (requires a lossy wire_dtype)
+    error_feedback: bool = False
+    # DEPRECATED alias for wire_dtype="bf16" (the pre-codec knob); kept
+    # so existing launch scripts and library callers keep working
     gossip_comm_dtype: str | None = None
     bilat: bool = False                       # AD-PSGD family
     # AD-PSGD with REAL wall-clock asynchrony: the compiled step carries
@@ -272,7 +281,8 @@ class Trainer:
                     cooldown_steps=config.health_every, log=self.log,
                     registry=self.telemetry.registry,
                     interconnect=self._plan_interconnect(),
-                    faults=bool(config.inject_faults))
+                    faults=bool(config.inject_faults),
+                    wire=self.wire_config())
 
         # per-rank files: each process writes its local ranks; the single
         # aggregate file is process 0's job
@@ -294,26 +304,53 @@ class Trainer:
             return InterconnectModel.from_dict(self.cfg.plan["interconnect"])
         return None
 
-    def _comm_dtype(self):
-        """Resolve the wire-compression dtype; reject unknown values rather
+    def _wire_codec(self):
+        """Resolve the wire codec from the config (wire_dtype, with the
+        deprecated gossip_comm_dtype alias); reject unknown values rather
         than silently running uncompressed."""
-        v = self.cfg.gossip_comm_dtype
-        if v is None:
-            return None
-        if v == "bf16":
-            import jax.numpy as jnp
+        from ..parallel import wire as wire_mod
 
-            return jnp.bfloat16
-        raise ValueError(f"unknown gossip_comm_dtype {v!r}; use 'bf16'")
+        cfg = self.cfg
+        if cfg.wire_dtype is not None:
+            if cfg.gossip_comm_dtype is not None \
+                    and cfg.wire_dtype != "bf16":
+                raise ValueError(
+                    "gossip_comm_dtype is a deprecated alias for "
+                    "wire_dtype=bf16 and conflicts with "
+                    f"wire_dtype={cfg.wire_dtype!r}")
+            return wire_mod.get_codec(cfg.wire_dtype, cfg.wire_block)
+        if cfg.gossip_comm_dtype is None:
+            return None
+        if cfg.gossip_comm_dtype != "bf16":
+            raise ValueError(f"unknown gossip_comm_dtype "
+                             f"{cfg.gossip_comm_dtype!r}; use 'bf16' "
+                             "(or the wire_dtype knob)")
+        return wire_mod.BF16
+
+    def wire_config(self) -> dict | None:
+        """JSON-safe wire stamp ({"dtype", "block", "error_feedback"}),
+        None when the run gossips exact f32 — what the planner prices on
+        and the plan/checkpoint meta record."""
+        codec = self._wire_codec()
+        if codec is None or not codec.lossy:
+            return None
+        return {**codec.to_dict(),
+                "error_feedback": bool(self.cfg.error_feedback)}
 
     def make_algorithm(self, ppi: int) -> GossipAlgorithm:
         cfg = self.cfg
         axis = self.gossip_axis
-        if (cfg.gossip_comm_dtype is not None
-                and (cfg.all_reduce or cfg.bilat or not cfg.push_sum)):
+        codec = self._wire_codec()
+        if codec is not None and codec.lossy \
+                and (cfg.all_reduce or cfg.bilat or not cfg.push_sum):
             raise ValueError(
-                "gossip_comm_dtype currently applies to the push-sum "
-                "family only")
+                "wire compression (wire_dtype / the deprecated "
+                "gossip_comm_dtype) applies to the push-sum family only")
+        if cfg.error_feedback and (cfg.all_reduce or cfg.bilat
+                                   or not cfg.push_sum):
+            raise ValueError(
+                "error_feedback rides the push-sum gossip wire; "
+                "all_reduce/bilateral/D-PSGD modes have none")
         if cfg.global_avg_every and (cfg.all_reduce or cfg.bilat
                                      or cfg.bilat_async):
             raise ValueError(
@@ -359,7 +396,8 @@ class Trainer:
         if cfg.push_sum:
             return sgp(schedule, axis, overlap=cfg.overlap,
                        gossip_every=cfg.gossip_every,
-                       comm_dtype=self._comm_dtype(),
+                       wire=codec,
+                       error_feedback=cfg.error_feedback,
                        staleness=staleness,
                        global_avg_every=cfg.global_avg_every,
                        faults=faults)
@@ -401,7 +439,8 @@ class Trainer:
     def _setup_telemetry(self, state, itr_per_epoch: int) -> None:
         """Attach the comm accountant for the active configuration and
         emit the run_meta event.  Pure host work, done once per fit."""
-        from ..telemetry import CommModel, tree_payload_bytes
+        from ..telemetry import (CommModel, encoded_payload_bytes,
+                                 tree_payload_bytes)
 
         cfg = self.cfg
         exact = tree_payload_bytes(state.params, self.gossip_world)
@@ -419,9 +458,12 @@ class Trainer:
             # extra construction)
             alg = self._train_fn(ppi_at_epoch(cfg.ppi_schedule, 0),
                                  itr_per_epoch)[0]
-            wire = (tree_payload_bytes(state.params, self.gossip_world,
-                                       itemsize=2)
-                    if cfg.gossip_comm_dtype == "bf16" else exact)
+            # price the ENCODED payload — dtype size plus int8 scale
+            # overhead, scalar leaves exempt — exactly what the codec
+            # puts on the ppermute (pinned against hand-counts)
+            codec = self._wire_codec()
+            wire = encoded_payload_bytes(state.params, self.gossip_world,
+                                         codec)
             # the fabric model the planner priced on classifies the
             # wire's ICI/DCN lanes too (one source of truth)
             interconnect = self._plan_interconnect()
@@ -430,7 +472,8 @@ class Trainer:
                 gossip_every=alg.gossip_every,
                 global_avg_every=alg.global_avg_every,
                 faults=alg.faults, ps_weight=cfg.push_sum,
-                interconnect=interconnect)
+                interconnect=interconnect, codec=codec,
+                error_feedback=cfg.error_feedback)
         self.telemetry.attach_comm(model)
         self.telemetry.registry.emit("run_meta", {
             "world": self.gossip_world, "algorithm": alg_name,
@@ -1020,16 +1063,18 @@ class Trainer:
         but recovered AFTER the chunk (a compiled scan cannot be
         interrupted mid-flight) — the cooldown keeps one excursion from
         firing once per inner step."""
-        from ..resilience.monitor import HEALTH_KEYS
+        from ..resilience.monitor import EF_HEALTH_KEY, HEALTH_KEYS
 
         if any(k not in metrics for k in HEALTH_KEYS):
             return state  # step function built without health signals
+        keys = HEALTH_KEYS + ((EF_HEALTH_KEY,)
+                              if EF_HEALTH_KEY in metrics else ())
         arrs = {k: np.asarray(metrics[k]).reshape(self.gossip_world, chunk)
-                for k in HEALTH_KEYS}
+                for k in keys}
         for j in range(chunk):
             # each signal is a collective over the gossip axis — every
             # rank carries the same value; read shard 0
-            sig = {k: float(arrs[k][0, j]) for k in HEALTH_KEYS}
+            sig = {k: float(arrs[k][0, j]) for k in keys}
             report = self.monitor.observe(gstep0 + j, sig)
             if report.unhealthy and self.recovery_policy is not None:
                 event = self.recovery_policy.assess(report)

@@ -177,7 +177,8 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             # must not re-average them — append afterwards.
             from ..resilience.monitor import health_signals
             metrics.update(health_signals(
-                params, grads, gstate.ps_weight, health_axis))
+                params, grads, gstate.ps_weight, health_axis,
+                ef_residual=gstate.ef_residual))
         new_state = state.replace(
             step=state.step + 1, params=params, batch_stats=batch_stats,
             opt_state=opt_state, gossip=gstate)

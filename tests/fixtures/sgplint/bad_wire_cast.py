@@ -1,0 +1,38 @@
+"""SGPL010: raw .astype wire cast on a ppermute payload.
+
+The gossip wire has exactly one encode path — parallel/wire.py's
+WireCodec family — so comm pricing, error feedback, and the compiled
+cast can never disagree.  An inline ``payload.astype(...)`` handed to
+``lax.ppermute`` bypasses all three.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PAIRS = [(0, 1), (1, 0)]
+
+
+@jax.jit
+def leaky_send(x):
+    # the legacy pre-codec idiom: cast down inline, ship, cast back
+    wire = lax.ppermute(x.astype(jnp.bfloat16), "gossip", PAIRS)  # EXPECT: SGPL010
+    return wire.astype(x.dtype)
+
+
+@jax.jit
+def nested_cast(x, w):
+    # the cast hides inside the payload expression — still a wire cast
+    return lax.ppermute((x * w).astype(jnp.float16), "gossip", PAIRS)  # EXPECT: SGPL010
+
+
+@jax.jit
+def clean_send(x):
+    # no cast on the wire: the payload ships in its own dtype (codecs
+    # would have encoded it upstream, in parallel/wire.py)
+    return lax.ppermute(x, "gossip", PAIRS)
+
+
+def host_side(x):
+    # NOT traced: astype here is ordinary host numpy-ish code, no wire
+    return x.astype(jnp.bfloat16)
