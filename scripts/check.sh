@@ -29,6 +29,10 @@ echo "== wire self-check (int8 + error-feedback gossip wire) =="
 python scripts/wirecheck.py --selftest
 
 echo
+echo "== overlap self-check (double-buffered gossip vs sync step time) =="
+python bench.py --overlap-vs-sync --selftest
+
+echo
 echo "== obsreport self-check (telemetry: tracer -> events -> report) =="
 python scripts/obsreport.py --selftest
 

@@ -9,6 +9,8 @@ from .algorithms import (
     adpsgd,
     all_reduce,
     dpsgd,
+    drain_in_flight,
+    drain_state,
     osgp,
     sgp,
 )
@@ -25,4 +27,6 @@ __all__ = [
     "osgp",
     "dpsgd",
     "adpsgd",
+    "drain_in_flight",
+    "drain_state",
 ]

@@ -23,7 +23,58 @@ from ..topology.schedule import GossipSchedule
 from .api import GossipAlgorithm, GossipState, Params
 
 __all__ = ["all_reduce", "sgp", "osgp", "dpsgd", "adpsgd",
+           "drain_in_flight", "drain_state",
            "AllReduce", "PushSumGossip", "PushPullGossip", "BilateralGossip"]
+
+
+def drain_in_flight(params, ps_weight, in_flight):
+    """Fold every overlap in-flight share into ``(params, ps_weight)``
+    and return the FIFO as zero slots.
+
+    This is THE mass fold of the double-buffered schedule — purely
+    per-rank adds (no collective): each pending share is network mass
+    that left its sender and has not yet landed, so consuming it early
+    is mean-preserving and counts it exactly once.  Single source of
+    truth for every drain site: the in-step exact average
+    (:meth:`PushSumGossip.global_average`), the validation view
+    (:meth:`PushSumGossip.val_params`), and both run layers' checkpoint
+    save barriers (train/loop.py, run/gossip_lm.py).  Works on
+    per-rank state inside ``shard_map`` and on world-stacked host
+    arrays alike (the adds are elementwise).
+
+    Returns ``(params, ps_weight, drained_fifo)``.
+    """
+    for in_p, in_w in in_flight:
+        params = jax.tree.map(
+            lambda p, b: p + jnp.asarray(b, jnp.asarray(p).dtype),
+            params, in_p)
+        ps_weight = ps_weight + jnp.reshape(jnp.asarray(in_w),
+                                            jnp.shape(ps_weight))
+    drained = tuple(
+        (jax.tree.map(jnp.zeros_like, in_p), jnp.zeros_like(in_w))
+        for in_p, in_w in in_flight)
+    return params, ps_weight, drained
+
+
+def drain_state(state):
+    """Drain a train-state-like object's overlap FIFO into its params:
+    the state-level wrapper around :func:`drain_in_flight` both run
+    layers use at the checkpoint save barrier (train/loop.py and
+    run/gossip_lm.py), so the checkpoint — and the continuing run,
+    which adopts the returned state — carries nothing in flight and
+    reshards/reloads like a sync checkpoint.  Duck-typed over anything
+    with ``.params``, ``.gossip`` (a :class:`~.api.GossipState`) and
+    flax-style ``.replace``; a no-op for sync runs and for staleness-1
+    overlap (whose FIFO is empty between steps)."""
+    fifo = getattr(getattr(state, "gossip", None), "in_flight", None)
+    if not fifo:
+        return state
+    params, ps_weight, drained = drain_in_flight(
+        state.params, state.gossip.ps_weight, fifo)
+    return state.replace(
+        params=params,
+        gossip=state.gossip.replace(ps_weight=ps_weight,
+                                    in_flight=drained))
 
 
 class AllReduce(GossipAlgorithm):
@@ -48,22 +99,57 @@ class PushSumGossip(GossipAlgorithm):
     (distributed.py:389-434 + gossiper.py:176-219 collapsed into one
     collective).
 
-    Overlap (overlap=True, ≙ OSGP, distributed.py:571-588): ``post_step``
-    keeps only the local share ``lo·x`` and stores the peers' contributions
-    in ``state.in_flight``; ``pre_step`` of a *later* iteration adds them —
-    the same staleness the reference gets from its gossip thread, except
-    the "thread" is XLA's collective scheduler overlapping the ppermute
-    with backprop compute.
+    Overlap (overlap=True, ≙ OSGP, distributed.py:571-588) is a
+    first-class *phase schedule*, double-buffered around the compute:
+    ``pre_step`` LAUNCHES round t at the top of the step —
+    :func:`~..parallel.collectives.overlap_launch` issues the
+    ``ppermute`` before the forward/backward, so XLA schedules the
+    collective behind backprop compute — keeping only the local share
+    ``lo·x`` and appending the incoming share to ``state.in_flight``;
+    ``post_step`` CONSUMES the oldest in-flight share at the bottom.
+    The de-bias ``x/w`` is invariant to the local rescale (both lanes
+    scale by ``lo``), so the gradient is still evaluated at the exact
+    de-biased iterate; the consumed share is one round stale, giving
+    the effective recursion ``x_{t+1} = W·x_t − lr·u_t`` at staleness 1
+    — the staleness-shifted mixing of "The Algorithm of Pipelined
+    Gossiping", whose augmented matrix
+    (:meth:`~..topology.schedule.GossipSchedule.overlap_schedule`) the
+    schedule verifier checks column-stochastic and contracting exactly
+    like sync schedules (SGPV106).
 
     ``staleness`` bounds how many steps an incoming share may ride in
     flight (≙ ``synch_freq``: the reference polls non-blocking for up to N
     steps before forcing a wait, distributed.py:127-129, :578, so its max
     staleness is ``synch_freq+1``; here the bound is exact rather than
-    comm-speed-dependent).  ``in_flight`` becomes a FIFO of ``staleness``
-    slots: ``pre_step`` consumes the oldest, ``post_step`` appends the
-    round just launched.  Memory cost: ``staleness`` extra parameter
-    copies.  Every launched share is consumed exactly once, so push-sum
-    mass conservation is preserved for any staleness.
+    comm-speed-dependent).  ``in_flight`` is a FIFO of ``staleness``
+    slots: ``pre_step`` fills the freed tail slot with the round just
+    launched, ``post_step`` pops the head (launched ``staleness − 1``
+    steps earlier).  Memory cost: ``staleness`` extra parameter copies.
+    Every launched share is consumed exactly once, so push-sum mass
+    conservation is preserved for any staleness.
+
+    Because overlap is a schedule rather than a mode flag, the feature
+    matrix composes like sync:
+
+    * ``wire`` / ``error_feedback`` — the residual is injected into, and
+      telescopes against, the round being SENT at launch time; a share
+      consumed steps later carries its quantization error already
+      accounted (staleness-aware EF carry).
+    * ``faults`` — keep/corrupt masks are resolved at the LAUNCH tick,
+      so a share launched under one fault state and consumed under
+      another stays mass-conserving (the sender reabsorbed the dropped
+      weight when the wire actually fired).
+    * ``gossip_every`` thinning — non-firing steps launch nothing (a
+      zero slot rides the FIFO) and the rotation advances with fired
+      rounds only, exactly like the sync thinned path.
+    * hierarchical schedules — only the delegate (inter/DCN) share is
+      deferred; the cheap ICI-local intra-slice psum runs at consume
+      time (it cannot ride in flight), so the expensive collective is
+      the hidden one.
+    * ``global_avg_every`` / reactive recovery — the exact average FOLDS
+      the in-flight FIFO into ``Σx/Σw`` and drains it (zero slots), so
+      nothing is double-counted: the averaged value is the true network
+      mean including in-flight mass.
 
     ``wire`` (a :class:`~..parallel.wire.WireCodec`) compresses gossip
     payloads on the ppermute boundary — bf16 or per-block int8; the
@@ -71,12 +157,12 @@ class PushSumGossip(GossipAlgorithm):
     adds the per-rank residual accumulator (``GossipState.ef_residual``)
     that re-injects each round's quantization error into the next send,
     bounding the compression perturbation (parallel/collectives.py
-    module docstring).  Synchronous mode only; composes with
-    ``gossip_every`` thinning (the residual waits out non-firing steps),
-    with fault injection (dropped edges carry their residual), and with
-    hierarchical schedules (the codec rides the delegate DCN lane; the
-    intra-slice psum stays exact).  The residual deliberately SURVIVES
-    exact global averages: it is sender-local pending correction, and
+    module docstring).  It composes with ``gossip_every`` thinning (the
+    residual waits out non-firing steps), with fault injection (dropped
+    edges carry their residual), with hierarchical schedules (the codec
+    rides the delegate DCN lane; the intra-slice psum stays exact), and
+    with overlap (above).  The residual deliberately SURVIVES exact
+    global averages: it is sender-local pending correction, and
     re-injecting it later loses nothing the average computed.
 
     ``global_avg_every`` interleaves an *exact* global average every k-th
@@ -86,8 +172,8 @@ class PushSumGossip(GossipAlgorithm):
     so the operation preserves the mean for any mixing (uniform or
     irregular) while snapping all ranks to consensus — the planner's
     recovery for topologies whose spectral gap is below the floor at the
-    requested world size.  Synchronous mode only (an in-flight overlap
-    share would be double-counted by the average).
+    requested world size.  Under overlap the average additionally drains
+    the in-flight FIFO (see above).
     """
 
     name = "sgp"
@@ -102,29 +188,20 @@ class PushSumGossip(GossipAlgorithm):
         self.overlap = overlap
         from ..topology.hierarchical import HierarchicalSchedule
 
-        if isinstance(schedule, HierarchicalSchedule):
-            # two-level rounds compile to leader ppermute + grouped psum
-            # (collectives._hier_round_fn); neither the overlap split nor
-            # per-edge fault masks decompose across that psum
-            if overlap:
-                raise ValueError(
-                    "overlap mode is not supported on hierarchical "
-                    "schedules: the intra-slice exact average cannot be "
-                    "deferred as an in-flight share")
-            if faults is not None:
-                raise ValueError(
-                    "inject_faults is not supported on hierarchical "
-                    "schedules: the intra-slice psum has no per-edge "
-                    "mask (use a flat topology for fault drills)")
+        if isinstance(schedule, HierarchicalSchedule) and faults is not None:
+            # two-level rounds compile to leader ppermute + grouped psum;
+            # the psum has no per-edge mask, so this fence REMAINS (the
+            # overlap fence was lifted: the delegate share defers cleanly,
+            # collectives.overlap_launch + intra_average at consume)
+            raise ValueError(
+                "inject_faults is not supported on hierarchical "
+                "schedules: the intra-slice psum has no per-edge "
+                "mask (use a flat topology for fault drills)")
         # deterministic fault injection (resilience/faults.py FaultMasks):
         # the mixing boundary applies the plan's keep/corrupt masks with
-        # mass-conserving reabsorption.  Synchronous mode only — an
-        # overlap share launched under one fault state and consumed under
-        # another would decouple the mask from the wire it describes.
-        if faults is not None and overlap:
-            raise ValueError(
-                "inject_faults is a synchronous-mode feature: overlap "
-                "in-flight shares would straddle fault windows")
+        # mass-conserving reabsorption.  Composes with overlap — masks
+        # are keyed on the LAUNCH tick, so the wire a mask describes is
+        # the wire that actually fired, whatever step consumes the share.
         if faults is not None and faults.gossip_every != gossip_every:
             # phase-dependent masks are resolved against the rotation
             # actually active at each tick, which depends on thinning
@@ -146,19 +223,12 @@ class PushSumGossip(GossipAlgorithm):
         # fewer communications per optimization step)
         if gossip_every < 1:
             raise ValueError("gossip_every must be >= 1")
-        if gossip_every > 1 and overlap:
-            raise ValueError(
-                "gossip_every > 1 is a synchronous-mode knob; overlap "
-                "already hides the collective behind compute")
         self.gossip_every = gossip_every
         # periodic exact global averaging every k-th step (0 = off);
-        # see the class docstring
+        # see the class docstring.  Under overlap the average folds and
+        # drains the in-flight FIFO, so nothing is double-counted.
         if global_avg_every < 0:
             raise ValueError("global_avg_every must be >= 0")
-        if global_avg_every and overlap:
-            raise ValueError(
-                "global_avg_every is a synchronous-mode knob: averaging "
-                "around in-flight overlap shares would double-count them")
         self.global_avg_every = global_avg_every
         # wire codec for gossip payloads (parallel/wire.py); comm_dtype
         # is the deprecated bf16-only alias — both resolve to one codec,
@@ -174,20 +244,16 @@ class PushSumGossip(GossipAlgorithm):
         self.comm_dtype = comm_dtype  # kept for introspection only
         # per-rank error-feedback residual accumulators (wire.py module
         # docstring): quantization error from round t re-injected into
-        # round t+1's send — requires a lossy codec to have any error,
-        # and synchronous mode (an overlap in-flight share would
-        # straddle residual windows the same way it straddles faults)
+        # round t+1's send — requires a lossy codec to have any error.
+        # Composes with overlap: the residual telescopes against the
+        # round being SENT at launch time (staleness-aware EF carry), so
+        # in-flight shares carry their quantization error pre-accounted.
         if error_feedback:
             if wire is None or not wire.lossy:
                 raise ValueError(
                     "error_feedback needs a lossy wire codec "
                     "(wire_dtype bf16/int8); exact wires have no "
                     "quantization error to feed back")
-            if overlap:
-                raise ValueError(
-                    "error_feedback is a synchronous-mode feature: "
-                    "overlap in-flight shares would straddle residual "
-                    "windows")
             if not track_weight:
                 raise ValueError(
                     "error_feedback rides the push-sum wire "
@@ -215,27 +281,26 @@ class PushSumGossip(GossipAlgorithm):
             params, phase, self.schedule, self.axis_name,
             codec=self.wire), ps_weight, None)
 
-    def _split_round(self, params, ps_weight, phase):
-        """One round split into (local share, incoming share).
-
-        local = lo·x; incoming = Σ_i w_i·ppermute(x) — their sum is exactly
-        the synchronous round, so overlap mode differs from sync only in
-        *when* the incoming share is applied.
+    def _launch(self, params, ps_weight, rotation, tick, residual):
+        """Launch one double-buffered round (collectives.overlap_launch):
+        returns ``(local_params, local_w, incoming, new_residual)`` where
+        ``incoming`` is the ``(params, w)`` share to defer in the FIFO.
+        local = lo·x; incoming = Σ_i ppermute(w_i·x) — their sum is
+        exactly the synchronous round, so overlap differs from sync only
+        in *when* the incoming share is applied.
         """
         tree = (params, ps_weight)
-        mixed = collectives.gossip_round(
-            tree, phase, self.schedule, self.axis_name,
-            codec=self.wire)
-        # local share is a cheap rescale; recover incoming by subtraction
-        # would lose precision — instead compute local share directly and
-        # subtract from the mixed total.
-        num_phases = self.schedule.num_phases
-        lo_table = jnp.asarray(self.schedule.self_weight, jnp.float32)
-        my_rank = jax.lax.axis_index(self.axis_name)
-        lo = lo_table[as_scalar(phase) % num_phases, my_rank]
-        local = jax.tree.map(lambda a: a * lo.astype(a.dtype), tree)
-        incoming = jax.tree.map(jnp.subtract, mixed, local)
-        return local, incoming
+        if residual is None:
+            local, incoming = collectives.overlap_launch(
+                tree, rotation, self.schedule, self.axis_name,
+                codec=self.wire, faults=self.faults, tick=tick)
+            return local[0], local[1], incoming, None
+        full_res = (residual, jax.tree.map(jnp.zeros_like, ps_weight))
+        local, incoming, new_res = collectives.overlap_launch(
+            tree, rotation, self.schedule, self.axis_name,
+            codec=self.wire, faults=self.faults, tick=tick,
+            ef_residual=full_res)
+        return local[0], local[1], incoming, new_res[0]
 
     # -- algorithm slots ---------------------------------------------------
 
@@ -260,19 +325,40 @@ class PushSumGossip(GossipAlgorithm):
     def pre_step(self, params, state):
         if not self.overlap:
             return params, state
-        # consume the OLDEST in-flight round (≙ _query_gossip_queue,
-        # distributed.py:336-387: p += r; ps_weight += gossip_ps_weight),
-        # then shift the FIFO; post_step fills the freed last slot
-        in_params, in_w = state.in_flight[0]
-        params = jax.tree.map(lambda p, b: p + b.astype(p.dtype),
-                              params, in_params)
-        ps_weight = state.ps_weight + jnp.reshape(
-            in_w, jnp.shape(state.ps_weight))
-        empty = (self._zeros_like_params(in_params),
-                 jnp.zeros_like(in_w))
-        in_flight = state.in_flight[1:] + (empty,)
-        return params, state.replace(ps_weight=ps_weight,
-                                     in_flight=in_flight)
+        # LAUNCH round t at the top of the step: the ppermute is issued
+        # before the forward/backward, so XLA schedules the collective
+        # behind compute.  Only the local share lo·x stays; the de-bias
+        # x/w is invariant to that rescale (both lanes scale by lo), so
+        # the gradient is still taken at the exact de-biased iterate.
+        # The incoming share fills the FIFO slot post_step freed.
+        tick = as_scalar(state.phase)
+        if self.gossip_every > 1:
+            fire = (tick % self.gossip_every) == 0
+            rotation = tick // self.gossip_every
+
+            def launch_branch(op):
+                p, w, r = op
+                return self._launch(p, w, rotation, tick, r)
+
+            def skip_branch(op):
+                # non-firing step: nothing launches; a zero share rides
+                # the FIFO so the consume clock stays uniform
+                p, w, r = op
+                return p, w, (self._zeros_like_params(p),
+                              jnp.zeros_like(w)), r
+
+            local_p, local_w, incoming, residual = jax.lax.cond(
+                fire, launch_branch, skip_branch,
+                (params, state.ps_weight, state.ef_residual))
+        else:
+            local_p, local_w, incoming, residual = self._launch(
+                params, state.ps_weight, tick, tick, state.ef_residual)
+        local_w = jnp.reshape(jnp.asarray(local_w, jnp.float32),
+                              jnp.shape(state.ps_weight))
+        in_flight = state.in_flight[:-1] + (incoming,)
+        return local_p, state.replace(ps_weight=local_w,
+                                      in_flight=in_flight,
+                                      ef_residual=residual)
 
     def eval_params(self, params, state):
         if not self.track_weight:
@@ -291,12 +377,8 @@ class PushSumGossip(GossipAlgorithm):
         untouched (pure eval-time view)."""
         if not self.overlap:
             return self.eval_params(params, state)
-        ps_weight = state.ps_weight
-        for in_p, in_w in state.in_flight:
-            params = jax.tree.map(lambda p, b: p + b.astype(p.dtype),
-                                  params, in_p)
-            ps_weight = ps_weight + jnp.reshape(in_w,
-                                                jnp.shape(ps_weight))
+        params, ps_weight, _ = drain_in_flight(params, state.ps_weight,
+                                               state.in_flight)
         if not self.track_weight:
             return params
         w = as_scalar(ps_weight)
@@ -317,11 +399,45 @@ class PushSumGossip(GossipAlgorithm):
             return params, state.replace(phase=phase + 1,
                                          ps_weight=ps_weight,
                                          ef_residual=residual)
-        # overlap: keep local share now, stash incoming for next pre_step
-        (local_p, local_w), incoming = self._split_round(
-            params, state.ps_weight, phase)
-        return self._finish_overlap(local_p, local_w, incoming, state,
-                                    phase)
+        # overlap: CONSUME the oldest in-flight round at the bottom of
+        # the step (≙ _query_gossip_queue, distributed.py:336-387:
+        # p += r; ps_weight += gossip_ps_weight), launched staleness−1
+        # steps ago by pre_step; the freed tail slot takes the next
+        # launch.  The round's ppermute had the whole forward/backward
+        # to complete.
+        tick = as_scalar(phase)
+        in_params, in_w = state.in_flight[0]
+        params = jax.tree.map(lambda p, b: p + b.astype(p.dtype),
+                              params, in_params)
+        ps_weight = state.ps_weight + jnp.reshape(
+            in_w, jnp.shape(state.ps_weight))
+        from ..topology.hierarchical import HierarchicalSchedule
+
+        if isinstance(self.schedule, HierarchicalSchedule):
+            # the deferred share was the delegate (DCN) half only; the
+            # ICI-local intra-slice psum runs now, on the round whose
+            # share was just consumed — gated so it fires exactly as
+            # often as the sync hierarchical round would
+            launch_tick = tick - (self.staleness - 1)
+            fired = launch_tick >= 0
+            if self.gossip_every > 1:
+                fired = jnp.logical_and(
+                    fired, (launch_tick % self.gossip_every) == 0)
+
+            def intra_branch(op):
+                return collectives.intra_average(op, self.schedule,
+                                                 self.axis_name)
+
+            params, ps_weight = jax.lax.cond(
+                fired, intra_branch, lambda op: op, (params, ps_weight))
+        empty = (self._zeros_like_params(in_params),
+                 jnp.zeros_like(in_w))
+        in_flight = state.in_flight[1:] + (empty,)
+        params, ps_weight, in_flight = self._maybe_global_average(
+            params, ps_weight, tick + 1, in_flight=in_flight)
+        return params, state.replace(phase=phase + 1,
+                                     ps_weight=ps_weight,
+                                     in_flight=in_flight)
 
     def _thinned_post_step(self, params, state):
         """Gossip on every ``gossip_every``-th call; the rotation phase
@@ -350,7 +466,7 @@ class PushSumGossip(GossipAlgorithm):
                                      ps_weight=ps_weight,
                                      ef_residual=residual)
 
-    def global_average(self, params, ps_weight):
+    def global_average(self, params, ps_weight, in_flight=None):
         """Exact push-sum consensus NOW: ``x ← Σ params / Σ ps_weight``
         (one allreduce) and the weight resets to 1.  Mass conservation
         makes that ratio the true parameter average under any
@@ -359,34 +475,45 @@ class PushSumGossip(GossipAlgorithm):
         consensus error snaps to zero.  Called per-rank inside
         shard_map; the periodic schedule (:meth:`_maybe_global_average`)
         and the resilience recovery path (resilience/recovery.py) both
-        route through here."""
+        route through here.
+
+        ``in_flight`` (the overlap FIFO) FOLDS pending shares into both
+        sums and returns the FIFO drained to zero slots: an in-flight
+        share is network mass that has left its sender and not yet
+        reached its receiver, so counting it exactly once — here — is
+        what keeps the average the true mean.  Returns
+        ``(params, ps_weight)`` or ``(params, ps_weight, drained_fifo)``.
+        """
+        drained = None
+        if in_flight is not None:
+            params, ps_weight, drained = drain_in_flight(
+                params, ps_weight, in_flight)
         tot_p, tot_w = collectives.allreduce_sum((params, ps_weight),
                                                  self.axis_name)
         tw = as_scalar(tot_w)
         params = jax.tree.map(lambda a: (a / tw.astype(a.dtype)), tot_p)
-        return params, jnp.ones_like(ps_weight)
+        if drained is None:
+            return params, jnp.ones_like(ps_weight)
+        return params, jnp.ones_like(ps_weight), drained
 
-    def _maybe_global_average(self, params, ps_weight, tick_next):
+    def _maybe_global_average(self, params, ps_weight, tick_next,
+                              in_flight=None):
         """Every ``global_avg_every`` steps: fire :meth:`global_average`
-        (periodic global averaging, Chen et al.)."""
+        (periodic global averaging, Chen et al.).  With ``in_flight``
+        (overlap) the fired average folds and drains the FIFO."""
         if self.global_avg_every <= 0:
-            return params, ps_weight
+            if in_flight is None:
+                return params, ps_weight
+            return params, ps_weight, in_flight
         fire = (as_scalar(tick_next) % self.global_avg_every) == 0
 
-        def avg_branch(operand):
-            return self.global_average(*operand)
-
-        return jax.lax.cond(fire, avg_branch, lambda o: o,
-                            (params, ps_weight))
-
-    def _finish_overlap(self, local_p, local_w, incoming, state, phase):
-        local_w = jnp.reshape(jnp.asarray(local_w, jnp.float32),
-                              jnp.shape(state.ps_weight))
-        # the just-launched round takes the FIFO's freed last slot
-        in_flight = state.in_flight[:-1] + (incoming,)
-        return local_p, state.replace(phase=phase + 1,
-                                      ps_weight=local_w,
-                                      in_flight=in_flight)
+        if in_flight is None:
+            return jax.lax.cond(
+                fire, lambda o: self.global_average(*o), lambda o: o,
+                (params, ps_weight))
+        return jax.lax.cond(
+            fire, lambda o: self.global_average(o[0], o[1], in_flight=o[2]),
+            lambda o: o, (params, ps_weight, in_flight))
 
 
 class PushPullGossip(PushSumGossip):

@@ -8,20 +8,23 @@ pure functions over an explicit :class:`GossipState`, slotted into the train
 step at fixed points:
 
 ```
-params, gstate = alg.pre_step(params, gstate)        # consume in-flight gossip
+params, gstate = alg.pre_step(params, gstate)        # overlap: LAUNCH round t
 z              = alg.eval_params(params, gstate)     # de-biased params for fwd
 grads          = alg.reduce_grads(grads)             # exact averaging (AR/local)
-params, gstate = alg.post_step(params, gstate)       # gossip round / launch
+params, gstate = alg.post_step(params, gstate)       # sync: gossip round;
+                                                     # overlap: consume round
+                                                     # t−staleness+1
 ```
 
 This is the hook dance of distributed.py:512-589 made explicit: ``pre_step``
-≙ the forward-pre hook's ``_query_gossip_queue`` (+ ``transfer_params`` in
-overlap mode), ``eval_params`` ≙ ``unbias`` (distributed.py:307-314),
-``reduce_grads`` ≙ the backward hook's intra-node reduction
-(distributed.py:520-562), ``post_step`` ≙ ``transfer_params`` + the gossip
-thread's ``mix`` (distributed.py:389-434, 459-510).  The ``is_ps_numerator``
-flag, heartbeat timeouts, poison values, and lock protocol all disappear:
-state is explicit and the collective is part of the compiled step.
+≙ the forward-pre hook's ``transfer_params`` (overlap launches at the top of
+the step so the collective hides behind backprop), ``eval_params`` ≙
+``unbias`` (distributed.py:307-314), ``reduce_grads`` ≙ the backward hook's
+intra-node reduction (distributed.py:520-562), ``post_step`` ≙ the gossip
+thread's ``mix`` / ``_query_gossip_queue`` consume (distributed.py:336-434,
+459-510).  The ``is_ps_numerator`` flag, heartbeat timeouts, poison values,
+and lock protocol all disappear: state is explicit and the collective is
+part of the compiled step.
 """
 
 from __future__ import annotations

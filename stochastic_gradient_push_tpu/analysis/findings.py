@@ -79,6 +79,14 @@ RULES: dict[str, tuple[str, str]] = {
         "configuration",
         "make the generator either produce a valid schedule or raise "
         "ValueError with a clear unsupported-configuration message"),
+    "SGPV106": (
+        "overlap (double-buffered) schedule is broken: the staleness-"
+        "shifted augmented matrix over (params, in-flight FIFO) is not "
+        "column-stochastic or its cycle product does not contract — "
+        "OSGP would leak push-sum mass or never reach consensus",
+        "fix the flat schedule so GossipSchedule.overlap_schedule() "
+        "passes the same bijection/column-sum/gap checks as the "
+        "synchronous tables"),
 }
 
 

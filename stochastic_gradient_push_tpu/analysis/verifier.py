@@ -19,7 +19,13 @@ invariants the paper's convergence analysis rests on:
 * **SGPV104** every bilateral pairing row is an involution (partner
   mismatch would deadlock the synchronous exchange);
 * **SGPV105** generators must either produce a valid schedule or refuse
-  a configuration with a clear ``ValueError`` — anything else is a bug.
+  a configuration with a clear ``ValueError`` — anything else is a bug;
+* **SGPV106** the overlap (double-buffered) form of every flat schedule
+  — :meth:`~..topology.schedule.GossipSchedule.overlap_schedule`, the
+  staleness-shifted augmented matrix over ``(params, in-flight FIFO)``
+  — passes the same bijection/column-stochasticity/contraction checks,
+  so OSGP's one-round-stale mixing conserves push-sum mass (in-flight
+  shares included) and still reaches consensus.
 
 All checks run on CPU in seconds: tables are numpy, never traced.
 """
@@ -279,6 +285,23 @@ def verify_topology(graph_cls, world: int, ppi: int,
         if np.isfinite(gap):
             gaps.append(GapEntry(graph_cls.__name__, world, ppi,
                                  mix_name, gap))
+        if not fs and getattr(schedule, "phase_kinds", None) is None:
+            # SGPV106: the double-buffered overlap form of the same
+            # tables must conserve mass and contract too.  Staleness 2
+            # is the canonical double-buffered round (one share in
+            # flight across the step boundary; staleness 1's effective
+            # matrix is the sync W itself, already checked above);
+            # deeper FIFOs are pinned by the algorithm tests.
+            # Hierarchical schedules have no augmented table form
+            # (their overlap round composes the deferred delegate
+            # share with an intra-slice psum) and are verified
+            # numerically at the collective layer.
+            ofs, _ = verify_schedule(
+                schedule.overlap_schedule(2),
+                f"{label} overlap(staleness=2)", file, line)
+            findings.extend(
+                Finding(f.file, f.line, "SGPV106", f.message)
+                for f in ofs)
 
     if check_pairing:
         try:

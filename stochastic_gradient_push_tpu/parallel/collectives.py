@@ -63,6 +63,8 @@ from . import wire as wire_mod
 __all__ = [
     "as_scalar",
     "gossip_round",
+    "overlap_launch",
+    "intra_average",
     "mix_push_sum",
     "mix_push_pull",
     "mix_bilat",
@@ -108,12 +110,18 @@ def _resolve_codec(codec, comm_dtype):
 
 
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
-              comm_dtype=None, faults=None, codec=None):
+              comm_dtype=None, faults=None, codec=None, split=False):
     """Build the mixing function for one static phase of the schedule.
 
     Returns ``mix(tree, tick, residual) -> (out, new_residual)``;
     ``tick`` is None without faults and ``residual`` is None without
-    error feedback (``new_residual`` is then None too).
+    error feedback (``new_residual`` is then None too).  With
+    ``split=True`` the function instead returns ``((local, incoming),
+    new_residual)`` — the same round separated into the kept local share
+    ``lo·x`` (reabsorbed fault weight included) and the received peer
+    contributions ``Σᵢ ppermute(wᵢ·x)``, whose sum IS the synchronous
+    round.  The split form is the double-buffered overlap round's launch
+    half: the caller applies ``local`` now and defers ``incoming``.
 
     ``codec`` (a :class:`~.wire.WireCodec`; ``comm_dtype`` is the
     deprecated bf16-only alias) compresses the wire payload: real
@@ -159,6 +167,12 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
         # untouched (scalar / exact) leaves carry their residual through
         err = list(res_in) if res_in is not None else None
         out = [a * lo.astype(a.dtype) for a in leaves]
+        # received contributions accumulate into the local share (sync)
+        # or into a separate incoming tree (overlap launch); fault
+        # reabsorption always lands in the LOCAL share — the sender
+        # keeps the undelivered weight, it is never in flight
+        inc = [jnp.zeros_like(a) for a in leaves] if split else None
+        acc = inc if split else out
         corrupt = (faults.corrupt_at(tick, axis_name)
                    if faults is not None and faults.any_corruption else None)
         for i in range(schedule.peers_per_itr):
@@ -207,17 +221,20 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                             err[j] = err[j] + q_err
                 else:
                     recv = lax.ppermute(msg, axis_name, pairs)
-                out[j] = out[j] + recv
+                acc[j] = acc[j] + recv
             if keep is not None and faults.reabsorb:
                 # sender reabsorbs the undelivered weight: the effective
-                # column still sums to 1 (mass conservation)
+                # column still sums to 1 (mass conservation).  In-place
+                # (`out` may be aliased by `acc` on the sync path)
                 drop_w = w_i * (1.0 - keep)
-                out = [o + a * drop_w.astype(a.dtype)
-                       for o, a in zip(out, leaves)]
-        mixed = jax.tree.unflatten(treedef, out)
+                for j, a in enumerate(leaves):
+                    out[j] = out[j] + a * drop_w.astype(a.dtype)
         new_res = (jax.tree.unflatten(jax.tree.structure(residual), err)
                    if res_in is not None else None)
-        return mixed, new_res
+        if split:
+            return (jax.tree.unflatten(treedef, out),
+                    jax.tree.unflatten(treedef, inc)), new_res
+        return jax.tree.unflatten(treedef, out), new_res
 
     return mix
 
@@ -241,17 +258,25 @@ def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
     """
     inter = _round_fn(hsched.inter_schedule, round_idx, axis_name,
                       comm_dtype, codec=codec)
-    groups = [list(g) for g in hsched.slice_groups]
-    inv_s = 1.0 / hsched.slice_size
 
     def mix(tree, tick, residual):
         t, new_res = inter(tree, tick, residual)
-        t = jax.tree.map(
-            lambda a: lax.psum(a * jnp.asarray(inv_s, a.dtype), axis_name,
-                               axis_index_groups=groups), t)
-        return t, new_res
+        return intra_average(t, hsched, axis_name), new_res
 
     return mix
+
+
+def intra_average(tree, hsched: HierarchicalSchedule, axis_name: str):
+    """The exact intra-slice average of a hierarchical round: ONE grouped
+    ``lax.psum`` over the slice sub-axis (ICI-local), numerically
+    ``W_intra @ tree``.  Public because the overlap consume path applies
+    it separately: the delegate (DCN) share is deferred in flight while
+    this cheap local collective stays at the bottom of the step."""
+    groups = [list(g) for g in hsched.slice_groups]
+    inv_s = 1.0 / hsched.slice_size
+    return jax.tree.map(
+        lambda a: lax.psum(a * jnp.asarray(inv_s, a.dtype), axis_name,
+                           axis_index_groups=groups), tree)
 
 
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
@@ -282,6 +307,59 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
     with a lossy codec; the call then returns ``(mixed, new_residual)``
     instead of ``mixed`` (see the module docstring for the semantics).
     """
+    mixed, new_res = _apply_round(tree, phase, schedule, axis_name,
+                                  comm_dtype, faults, tick, codec,
+                                  ef_residual, split=False)
+    return mixed if ef_residual is None else (mixed, new_res)
+
+
+def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
+                   comm_dtype=None, faults=None, tick=None, codec=None,
+                   ef_residual=None):
+    """Launch half of the double-buffered overlap round.
+
+    Issues round ``phase``'s ``ppermute`` NOW — called at the TOP of the
+    train step, so XLA schedules the collective behind the forward/
+    backward compute — and returns ``(local, incoming)``: the kept local
+    share ``lo·x`` and the received peer contributions, whose sum is
+    exactly the synchronous :func:`gossip_round`.  The caller applies
+    ``local`` immediately and defers ``incoming`` (the in-flight FIFO in
+    ``algorithms.GossipState``); consuming every launched share exactly
+    once preserves push-sum mass for any staleness, which is the
+    invariant ``analysis.verify_schedule`` checks on
+    :meth:`~..topology.schedule.GossipSchedule.overlap_schedule`'s
+    augmented tables (SGPV106).
+
+    Feature composition matches the synchronous round — this is what
+    makes overlap a first-class phase schedule rather than a mode flag:
+
+    * ``faults``: keep/corrupt masks are resolved at the LAUNCH tick
+      (``tick``), so a share launched under one fault state and consumed
+      under another stays mass-conserving — the sender reabsorbed the
+      undelivered weight when the wire actually fired;
+    * ``codec`` / ``ef_residual``: the residual is injected into (and the
+      new residual telescopes against) the round being SENT, not the
+      round being consumed;
+    * hierarchical schedules defer the delegate (inter/DCN) share only;
+      the caller runs :func:`intra_average` after consuming (the cheap
+      ICI-local psum stays synchronous — it cannot ride in flight).
+
+    Returns ``(local, incoming)``, or ``(local, incoming, new_residual)``
+    when ``ef_residual`` is given.
+    """
+    out, new_res = _apply_round(tree, phase, schedule, axis_name,
+                                comm_dtype, faults, tick, codec,
+                                ef_residual, split=True)
+    local, incoming = out
+    if ef_residual is None:
+        return local, incoming
+    return local, incoming, new_res
+
+
+def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
+                 tick, codec, ef_residual, split):
+    """Shared dispatch of one (possibly split) gossip round: validation,
+    per-phase branch construction, traced-phase ``lax.switch``."""
     if isinstance(schedule, HierarchicalSchedule) and faults is not None:
         # static configuration error: reject before any axis
         # introspection so the message survives outside a mesh context
@@ -299,12 +377,21 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
             f"schedule was built for world_size={schedule.world_size} but "
             f"mesh axis '{axis_name}' has size {axis_size}")
     if schedule.world_size == 1:
-        return tree if ef_residual is None else (tree, ef_residual)
+        if split:
+            return (tree, jax.tree.map(jnp.zeros_like, tree)), ef_residual
+        return tree, ef_residual
 
     if isinstance(schedule, HierarchicalSchedule):
         rounds = schedule.rounds_per_cycle
-        branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype,
-                                   codec) for q in range(rounds)]
+        if split:
+            # overlap launch: the delegate ppermute only — the caller
+            # runs intra_average when the share is consumed
+            branches = [_round_fn(schedule.inter_schedule, q, axis_name,
+                                  comm_dtype, codec=codec, split=True)
+                        for q in range(rounds)]
+        else:
+            branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype,
+                                       codec) for q in range(rounds)]
         idx = as_scalar(phase) % rounds
         fault_tick = None
     else:
@@ -313,16 +400,15 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
         else:
             fault_tick = None
         branches = [_round_fn(schedule, p, axis_name, comm_dtype, faults,
-                              codec) for p in range(schedule.num_phases)]
+                              codec, split=split)
+                    for p in range(schedule.num_phases)]
         idx = as_scalar(phase) % schedule.num_phases
 
     operand = (tree, fault_tick, ef_residual)
     if len(branches) == 1:
-        mixed, new_res = branches[0](*operand)
-    else:
-        mixed, new_res = lax.switch(
-            idx, [lambda op, fn=fn: fn(*op) for fn in branches], operand)
-    return mixed if ef_residual is None else (mixed, new_res)
+        return branches[0](*operand)
+    return lax.switch(
+        idx, [lambda op, fn=fn: fn(*op) for fn in branches], operand)
 
 
 def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,

@@ -79,9 +79,15 @@ class PlanConstraints:
     # A model with slice structure also fixes the hierarchical
     # candidate's slice decomposition to the fabric's.
     interconnect: InterconnectModel | None = None
-    # the run requests overlap mode / fault injection — synchronous
-    # flat-schedule features the hierarchical compiled round rejects at
-    # launch, so hierarchical candidates must not win the ranking
+    # the run requests overlap mode / fault injection.  Fault injection
+    # is a flat-schedule feature (the hierarchical grouped psum has no
+    # per-edge mask), so hierarchical candidates must not win a faulted
+    # run's ranking.  Overlap composes with EVERY candidate — the
+    # hierarchical round defers its delegate (DCN) share and keeps the
+    # ICI-local psum at consume time — so it no longer constrains the
+    # search at all; the field is accepted for API stability only (the
+    # run's overlap mode is recorded by the telemetry comm model, not
+    # the plan stamp).
     overlap: bool = False
     faults: bool = False
     # wire codec config ({"dtype", "block", "error_feedback"},
@@ -255,10 +261,13 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
         # hierarchical two-level graph) would be rejected by the
         # algorithm at launch, so it must not win the ranking
         cands = [c for c in cands if c.regular]
-    if cons.overlap or cons.faults:
-        # PushSumGossip rejects hierarchical schedules under overlap and
-        # fault injection (the grouped psum has no split/per-edge mask),
-        # so the planner must not recommend one to such a run
+    if cons.faults:
+        # PushSumGossip rejects hierarchical schedules under fault
+        # injection (the grouped psum has no per-edge mask), so the
+        # planner must not recommend one to such a run.  Overlap no
+        # longer constrains the ranking: the hierarchical round defers
+        # its delegate share like any flat edge (overlap_launch +
+        # intra_average at consume).
         cands = [c for c in cands if not c.slice_size]
     if not cands:
         raise ValueError(
@@ -268,8 +277,8 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
                else "")
             + (" for algorithm=dpsgd (regular schedules only)"
                if algorithm == "dpsgd" else "")
-            + (" compatible with overlap/fault injection (flat "
-               "schedules only)" if cons.overlap or cons.faults else ""))
+            + (" compatible with fault injection (flat schedules only)"
+               if cons.faults else ""))
     best = cands[0]
     warnings: list[str] = []
 
@@ -372,11 +381,11 @@ def check_topology(world: int, graph_class, ppi: int = 1,
         raise ValueError(
             f"dpsgd requires a regular (doubly-stochastic) schedule; "
             f"{name} is irregular — use push-sum (sgp) or a flat topology")
-    if cand.slice_size and (overlap or faults):
+    if cand.slice_size and faults:
         raise ValueError(
-            f"{name} is a two-level hierarchical schedule; overlap mode "
-            "and fault injection are flat-schedule features (the grouped "
-            "psum has no split/per-edge mask) — use a flat topology")
+            f"{name} is a two-level hierarchical schedule; fault "
+            "injection is a flat-schedule feature (the grouped psum has "
+            "no per-edge mask) — use a flat topology for fault drills")
     gap, mixing, alpha = cand.gap, "uniform", None
     rationale = f"user-forced {name} (ppi {ppi}): gap {gap:.4f}"
     if cand.slice_size:
@@ -455,9 +464,12 @@ def resolve_topology(world: int, *, ppi: int = 1,
       interconnect: fabric cost model from the CLI's --slice_size /
         --dcn_cost / --ici_cost flags (None = uniform fabric); candidate
         pricing and the hierarchical slice decomposition follow it.
-      overlap / faults: the run requests overlap mode / fault injection;
-        hierarchical schedules reject both at launch, so auto mode
-        excludes them from the ranking and forced mode fails fast.
+      overlap / faults: the run requests overlap mode / fault injection.
+        Hierarchical schedules reject fault injection at launch, so a
+        faulted run's auto ranking excludes them and forced mode fails
+        fast; overlap composes with every candidate (the hierarchical
+        delegate share defers like any flat edge) and only rides into
+        the plan stamp.
       wire: the run's wire codec config from --wire_dtype/--wire_block/
         --error_feedback ({"dtype", "block", "error_feedback"}); gossip
         lanes are priced at the encoded fraction and the config is

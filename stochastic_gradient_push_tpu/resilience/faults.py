@@ -38,6 +38,14 @@ tables in :class:`~..topology.schedule.GossipSchedule` form so
 ``reabsorb=False`` builds *naive* (mass-leaking) masks — never for
 training; it exists so tests can prove the runtime monitor detects a
 mass-leaking implementation within ``--health_every`` steps.
+
+**Overlap (OSGP) composition.**  The keep/corrupt rows are looked up at
+the tick the wire actually fires — the LAUNCH tick of the double-
+buffered round (``collectives.overlap_launch`` passes it through) — so
+a share launched under one fault state and consumed steps later under
+another stays mass-conserving: the sender reabsorbed the undelivered
+weight at send time, and the dropped message rides the in-flight FIFO
+as an exact zero.  No mask ever describes a wire it didn't see.
 """
 
 from __future__ import annotations

@@ -89,15 +89,23 @@ def _probe_leaf(params):
 
 def health_signals(params, grads, ps_weight, axis_name: str,
                    probe_slots: int = DEFAULT_PROBE_SLOTS,
-                   ef_residual=None) -> dict:
+                   ef_residual=None, in_flight=None) -> dict:
     """In-graph health reductions; call inside the compiled step (within
     shard_map) AFTER ``post_step``.  Returns float32 scalars that are
     identical on every rank (each is a collective over ``axis_name``), so
     the host can read any one shard.
 
+    ``in_flight`` (the overlap FIFO, ``GossipState.in_flight``) makes
+    the signals observe the DRAINED view: at staleness ≥ 2 weight mass
+    legitimately rides the FIFO across the step boundary, so without
+    the fold every overlap window would read as a push-sum mass leak —
+    and false-trigger reactive recovery — when conservation actually
+    holds.  Pass it whenever the algorithm runs overlap; ``None``/empty
+    is the sync no-op.
+
     Cost: two scalar psums, one pmin/pmax pair, one ``probe_slots``-wide
     pmean+psum, and one elementwise isfinite sweep — noise next to a
-    forward/backward.
+    forward/backward (plus ``staleness`` per-leaf adds under overlap).
     """
     import jax
     import jax.numpy as jnp
@@ -105,6 +113,11 @@ def health_signals(params, grads, ps_weight, axis_name: str,
 
     from ..parallel.collectives import as_scalar
 
+    if in_flight:
+        from ..algorithms.algorithms import drain_in_flight
+
+        params, ps_weight, _ = drain_in_flight(params, ps_weight,
+                                               in_flight)
     w = as_scalar(ps_weight).astype(jnp.float32)
     world = lax.axis_size(axis_name)
 

@@ -50,6 +50,15 @@ def make_recovery_fn(algorithm, mesh, axis_name: str = GOSSIP_AXIS):
     exact machinery of the in-step periodic average
     (algorithms.PushSumGossip.global_average), so the recovery action
     and the planned schedule can never drift apart semantically.
+
+    For an overlap algorithm the signature grows the in-flight FIFO:
+    ``(params, ps_weight, in_flight) -> (params, ps_weight, in_flight)``.
+    The reactive average FOLDS the pending shares into ``Σx/Σw`` and
+    returns the FIFO drained — an in-flight share is network mass that
+    left its sender and has not yet landed, so counting it exactly once
+    keeps the average the true mean (the same double-count fix the
+    reshard boundary applies).  Nothing is un-drainable here: every
+    launched share is data sitting in the carried state.
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -58,17 +67,23 @@ def make_recovery_fn(algorithm, mesh, axis_name: str = GOSSIP_AXIS):
         raise ValueError(
             f"{type(algorithm).__name__} has no global_average; recovery "
             "applies to the push-sum/D-PSGD gossip family")
+    squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
+    unsqueeze = lambda t: jax.tree.map(lambda a: a[None], t)
+
     if getattr(algorithm, "overlap", False):
-        # same invariant as global_avg_every: averaging around in-flight
-        # overlap shares would double-count them
-        raise ValueError(
-            "recovery global-average is a synchronous-mode action: "
-            "overlap in-flight shares would be double-counted")
+        def run_overlap(params, ps_weight, in_flight):
+            p, w, fl = algorithm.global_average(
+                squeeze(params), squeeze(ps_weight),
+                in_flight=squeeze(in_flight))
+            return unsqueeze(p), unsqueeze(w), unsqueeze(fl)
+
+        return jax.jit(jax.shard_map(
+            run_overlap, mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+            out_specs=(P(axis_name), P(axis_name), P(axis_name))))
 
     def run(params, ps_weight):
-        squeeze = lambda t: jax.tree.map(lambda a: a[0], t)
         p, w = algorithm.global_average(squeeze(params), squeeze(ps_weight))
-        unsqueeze = lambda t: jax.tree.map(lambda a: a[None], t)
         return unsqueeze(p), unsqueeze(w)
 
     return jax.jit(jax.shard_map(

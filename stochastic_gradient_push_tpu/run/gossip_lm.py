@@ -24,8 +24,10 @@ import os
 import time
 
 from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
-from .gossip_sgd import (add_wire_flags, reject_push_sum_wire_knobs,
-                         resolve_wire_flags, wire_plan_config)
+from .gossip_sgd import (add_staleness_flag, add_wire_flags,
+                         reject_push_sum_wire_knobs,
+                         resolve_staleness_flag, resolve_wire_flags,
+                         wire_plan_config)
 
 __all__ = ["main", "build_parser"]
 
@@ -36,6 +38,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--all_reduce", default="False", type=str)
     p.add_argument("--push_sum", default="True", type=str)
     p.add_argument("--overlap", default="False", type=str)
+    add_staleness_flag(p)
     p.add_argument("--bilat", default="False", type=str,
                    help="AD-PSGD: bilateral perfect-matching averaging "
                         "(synchronous formulation; see algorithms.py)")
@@ -310,6 +313,7 @@ def main(argv=None):
     # resilience/mixing flag validation (same error text as gossip_sgd,
     # fail before any device work)
     resolve_wire_flags(args)
+    resolve_staleness_flag(args, sb(args.overlap))
     args.mixing_alpha = _parse_mixing_alpha(args.mixing_alpha)
     if args.mixing_alpha is not None and (
             sb(args.all_reduce) or not sb(args.push_sum)):
@@ -332,10 +336,8 @@ def main(argv=None):
             raise SystemExit("--inject_faults needs push-sum gossip: only "
                              "push-sum's mass accounting keeps the mean "
                              "exact under dropped edges")
-        if sb(args.overlap):
-            raise SystemExit("--inject_faults is a synchronous-mode "
-                             "feature: overlap in-flight shares would "
-                             "straddle fault windows")
+        # overlap composes with faults (masks are keyed on the launch
+        # tick, resilience/faults.py)
         from ..resilience import parse_fault_spec
 
         fault_plan = parse_fault_spec(args.inject_faults)
@@ -576,6 +578,7 @@ def main(argv=None):
             from ..parallel.wire import get_codec
 
             alg = sgp(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
+                      staleness=max(1, args.staleness),
                       gossip_every=args.gossip_every,
                       wire=get_codec(args.wire_dtype, args.wire_block),
                       error_feedback=bool(args.error_feedback),
@@ -583,6 +586,7 @@ def main(argv=None):
         else:
             reject_push_sum_wire_knobs(args)
             alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
+                        staleness=max(1, args.staleness),
                         global_avg_every=gae, faults=faults)
 
     tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
@@ -699,7 +703,9 @@ def main(argv=None):
                 global_avg_every=alg.global_avg_every,
                 faults=alg.faults, ps_weight=sb(args.push_sum),
                 interconnect=interconnect, codec=codec,
-                error_feedback=bool(args.error_feedback))
+                error_feedback=bool(args.error_feedback),
+                overlap=getattr(alg, "overlap", False),
+                staleness=getattr(alg, "staleness", 1))
         rt.attach_comm(comm_model)
     if rt.enabled:
         rt.registry.emit("run_meta", {
@@ -800,6 +806,13 @@ def main(argv=None):
                 "tokens_per_sec": 0.0, "already_complete": True}
 
     def save_ckpt(st, step):
+        """Checkpoint ``st`` (draining overlap in-flight shares into
+        params first — algorithms.drain_state, the shared fold — so
+        the checkpoint and the continuing run carry nothing in flight)
+        and return the state the run should continue from."""
+        from ..algorithms import drain_state
+
+        st = drain_state(st)
         meta = {"step": step}
         if plan is not None:
             # reproducibility: the launch-time topology plan rides with
@@ -818,6 +831,7 @@ def main(argv=None):
             else:
                 ckpt.save(host_local_slice(st) if proc_count > 1 else st,
                           meta)
+        return st
 
     if args.corpus_file:
         from ..data.lm import load_corpus
@@ -903,8 +917,9 @@ def main(argv=None):
         # fetch — step-time samples are per-WINDOW deltas, so a straggler
         # phase moves p99 instead of dissolving into the lifetime mean
         health_window_start = None
-        if dp > 1 and hasattr(alg, "global_average") \
-                and not sb(args.overlap):
+        # overlap runs recover too: the compiled recovery average folds
+        # the in-flight FIFO into Σx/Σw and drains it (recovery.py)
+        if dp > 1 and hasattr(alg, "global_average"):
             policy = RecoveryPolicy(
                 world=dp, ppi=args.peers_per_itr,
                 algorithm="sgp" if sb(args.push_sum) else "dpsgd",
@@ -1071,12 +1086,22 @@ def main(argv=None):
                             if event.action == "global-average":
                                 with rt.span("recovery_global_average",
                                              "recovery"):
-                                    new_p, new_w = recovery(
-                                        state.params, state.gossip.ps_weight)
+                                    if getattr(alg, "overlap", False):
+                                        new_p, new_w, new_fl = recovery(
+                                            state.params,
+                                            state.gossip.ps_weight,
+                                            state.gossip.in_flight)
+                                        new_g = state.gossip.replace(
+                                            ps_weight=new_w,
+                                            in_flight=new_fl)
+                                    else:
+                                        new_p, new_w = recovery(
+                                            state.params,
+                                            state.gossip.ps_weight)
+                                        new_g = state.gossip.replace(
+                                            ps_weight=new_w)
                                     state = state.replace(
-                                        params=new_p,
-                                        gossip=state.gossip.replace(
-                                            ps_weight=new_w))
+                                        params=new_p, gossip=new_g)
                                 if rt.comm is not None:
                                     rt.comm.on_recovery()
                     loss = float(np.mean(mh["loss"]))
@@ -1115,7 +1140,7 @@ def main(argv=None):
                     with open(out_fname, "a") as f:
                         print(row, file=f)
                 if args.ckpt_every and steps_done % args.ckpt_every == 0:
-                    save_ckpt(state, steps_done)
+                    state = save_ckpt(state, steps_done)
                     last_saved = steps_done
                 if cluster.any_rank_signalled():
                     # preemption: the in-flight step is done — save,
@@ -1125,7 +1150,7 @@ def main(argv=None):
                         "%d and exiting %d (requeue me)",
                         cluster.last_signal or "peer flag", steps_done,
                         REQUEUE_EXIT_CODE)
-                    save_ckpt(state, steps_done)
+                    state = save_ckpt(state, steps_done)
                     last_saved = steps_done
                     if use_orbax:
                         ckpt.wait()
@@ -1141,7 +1166,7 @@ def main(argv=None):
                     break
             epoch += 1
         if last_saved != steps_done:
-            save_ckpt(state, steps_done)
+            state = save_ckpt(state, steps_done)
         if use_orbax:
             ckpt.wait()  # async saves must land before exit
             ckpt.close()

@@ -84,11 +84,43 @@ def resolve_wire_flags(args) -> None:
         raise SystemExit(
             "--error_feedback needs a lossy --wire_dtype (bf16/int8): "
             "an exact wire has no quantization error to feed back")
-    if ef and _str_bool(str(getattr(args, "overlap", "False"))):
-        raise SystemExit(
-            "--error_feedback is a synchronous-mode feature: overlap "
-            "in-flight shares would straddle residual windows")
+    # error feedback composes with overlap: the residual telescopes
+    # against the round being SENT at launch time (staleness-aware
+    # carry), so no overlap rejection here anymore
     args.error_feedback = ef
+
+
+def add_staleness_flag(p: argparse.ArgumentParser) -> None:
+    """The overlap staleness bound, shared by both run CLIs (gossip_sgd
+    and gossip_lm): the in-flight FIFO depth of the double-buffered
+    phase schedule."""
+    p.add_argument("--staleness", default=0, type=int,
+                   help="overlap-mode staleness bound: the in-flight "
+                        "FIFO depth — a share launched at the top of "
+                        "step t is consumed at the bottom of step "
+                        "t+staleness-1 (staleness 1 hides the ppermute "
+                        "behind the same step's compute; higher values "
+                        "also tolerate cross-step comm latency, "
+                        "reference semantics staleness = synch_freq+1, "
+                        "distributed.py:127-129).  0 = derive from "
+                        "--synch_freq")
+
+
+def resolve_staleness_flag(args, overlap: bool) -> None:
+    """Validate --staleness in place (shared by both CLIs): non-negative,
+    consistent with any --synch_freq alias, and overlap-only."""
+    staleness = getattr(args, "staleness", 0)
+    synch_freq = getattr(args, "synch_freq", 0)
+    if staleness < 0:
+        raise SystemExit("--staleness must be >= 0 (0 = derive from "
+                         "--synch_freq)")
+    if staleness and synch_freq and staleness != synch_freq + 1:
+        raise SystemExit(
+            f"--staleness {staleness} conflicts with --synch_freq "
+            f"{synch_freq} (staleness = synch_freq + 1); set one of "
+            "the two")
+    if staleness > 1 and not overlap:
+        raise SystemExit("--staleness is an overlap-mode knob")
 
 
 def reject_push_sum_wire_knobs(args) -> None:
@@ -222,6 +254,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "consumed synch_freq+1 steps after launch "
                         "(reference semantics: up to N non-blocking polls, "
                         "distributed.py:127-129)")
+    add_staleness_flag(p)
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step only (communication "
                         "thinning; sync push-sum mode)")
@@ -338,6 +371,7 @@ def parse_config(argv=None):
         raise SystemExit("peers_per_itr_schedule must include epoch 0")
     all_reduce = _str_bool(args.all_reduce)
     resolve_wire_flags(args)
+    resolve_staleness_flag(args, _str_bool(args.overlap))
     if all_reduce or not _str_bool(args.push_sum):
         # fail at parse time with the same text as the LM CLI's branches
         reject_push_sum_wire_knobs(args)
@@ -361,11 +395,8 @@ def parse_config(argv=None):
             raise SystemExit("--inject_faults needs push-sum gossip: only "
                              "push-sum's mass accounting keeps the mean "
                              "exact under dropped edges")
-        if _str_bool(args.overlap):
-            raise SystemExit("--inject_faults is a synchronous-mode "
-                             "feature: overlap in-flight shares would "
-                             "straddle fault windows")
-        # fail at parse time, not at first compiled step
+        # overlap composes with faults (masks are keyed on the LAUNCH
+        # tick); fail bad specs at parse time, not at first compiled step
         from ..resilience import parse_fault_spec
 
         parse_fault_spec(args.inject_faults)
@@ -387,6 +418,7 @@ def parse_config(argv=None):
         push_sum=_str_bool(args.push_sum),
         overlap=_str_bool(args.overlap),
         synch_freq=args.synch_freq,
+        staleness=args.staleness,
         bilat=getattr(args, "bilat", False),
         graph_class=graph_class,
         mixing_class=MIXING_STRATEGIES[args.mixing_strategy],

@@ -26,10 +26,16 @@ Everything here is host-side numpy over msgpack state dicts; no mesh,
 no jax arrays — a supervisor process can reshard a dead run's
 checkpoints without ever touching an accelerator.
 
-Scope: the synchronous push-sum / D-PSGD family.  Overlap checkpoints
-carry in-flight gossip (``gossip/in_flight``) that belongs to a
-specific schedule and cannot be re-attributed across worlds; they are
-rejected.
+Scope: the push-sum / D-PSGD family, synchronous or overlap.  Overlap
+checkpoints carry in-flight gossip (``gossip/in_flight``) — network
+mass that left its sender and has not yet landed.  The collapse FOLDS
+those shares into ``Σx/Σw`` (counting each exactly once, the same
+double-count fix the reactive recovery average applies) and re-stacks
+the FIFO as zero slots at the new world, so a formerly-overlap
+checkpoint reshards exactly like a sync one.  The run layer also
+drains the FIFO into params at every checkpoint save (train/loop.py),
+so the fold is usually a no-op on zero slots — it exists so older
+undrained checkpoints and mid-flight crash dumps stay reshardable.
 """
 
 from __future__ import annotations
@@ -149,14 +155,56 @@ def _ps_weight(state: dict) -> np.ndarray:
     return np.asarray(gossip["ps_weight"], np.float64).reshape(-1)
 
 
+def _in_flight_slots(state: dict) -> list[tuple[dict, np.ndarray]]:
+    """Overlap FIFO slots from a serialized gossip state: a list of
+    ``(params_subtree, ps_weight_rows)`` pairs, ``[]`` for a sync run.
+    Each slot is one launched-but-unconsumed gossip share — network
+    mass the consensus collapse must count exactly once."""
+    fifo = state.get("gossip", {}).get("in_flight")
+    if fifo is None or fifo == {}:
+        return []
+    if not isinstance(fifo, dict) or not all(
+            str(k).isdigit() for k in fifo):
+        raise ValueError(
+            "unrecognized gossip/in_flight layout: expected the "
+            "serialized overlap FIFO of (params, ps_weight) slots; "
+            "these in-flight shares cannot be drained into the "
+            "consensus")
+    slots = []
+    for key in sorted(fifo, key=int):
+        slot = fifo[key]
+        if not (isinstance(slot, dict) and set(slot) == {"0", "1"}):
+            raise ValueError(
+                f"in-flight slot {key} is not a (params, ps_weight) "
+                "pair; this FIFO cannot be drained into the consensus")
+        w = np.asarray(slot["1"], np.float64).reshape(-1)
+        if not np.all(np.isfinite(w)) or np.any(w < 0):
+            raise ValueError(
+                f"in-flight slot {key} carries non-finite or negative "
+                f"ps-weight mass {w}; refusing to fold it into the "
+                "consensus")
+        slots.append((slot["0"], w))
+    return slots
+
+
 def consensus_mean(state: dict) -> dict:
     """Per-leaf exact consensus of the params subtree, in float64:
-    ``Σ rank rows / Σ ps_weight`` — the quantity the restart boundary
-    must preserve.  Used by the reshard itself, its report, and the
-    selftest's independent before/after comparison."""
-    w_sum = float(_ps_weight(state).sum())
-    return {"/".join(path): np.asarray(leaf, np.float64).sum(0) / w_sum
-            for path, leaf in _walk(state["params"])}
+    ``(Σ rank rows + Σ in-flight shares) / (Σ ps_weight + Σ in-flight
+    weight)`` — the quantity the restart boundary must preserve.  Used
+    by the reshard itself, its report, and the selftest's independent
+    before/after comparison.  The in-flight fold is a no-op for sync
+    (and drained-overlap) states."""
+    slots = _in_flight_slots(state)
+    w_sum = (float(_ps_weight(state).sum())
+             + sum(float(w.sum()) for _, w in slots))
+    out = {}
+    for path, leaf in _walk(state["params"]):
+        num = np.asarray(leaf, np.float64).sum(0)
+        for slot_params, _ in slots:
+            num = num + np.asarray(_leaf_at(slot_params, path),
+                                   np.float64).sum(0)
+        out["/".join(path)] = num / w_sum
+    return out
 
 
 def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
@@ -170,8 +218,10 @@ def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
     * ``gossip/ps_weight`` — reset to 1 (the replicas are exact);
     * ``gossip/phase`` — reset to 0 (the new schedule's phase count may
       differ from the old one's);
-    * ``gossip/in_flight`` — must be ``None``: overlap in-flight shares
-      belong to a schedule that no longer exists;
+    * ``gossip/in_flight`` — FOLDED into the consensus (each pending
+      share is network mass counted exactly once in both ``Σx`` and
+      ``Σw``) and re-stacked as zero slots at the new world — the new
+      schedule starts with nothing in flight;
     * ``gossip/ef_residual`` — reset to zeros at the new world.  The
       error-feedback residual is *pending* quantization correction, not
       network mass: the consensus collapse above already averages what
@@ -186,11 +236,7 @@ def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
     """
     if new_world < 1:
         raise ValueError(f"new_world must be >= 1, got {new_world}")
-    in_flight = state.get("gossip", {}).get("in_flight")
-    if in_flight is not None and in_flight != {}:
-        raise ValueError(
-            "overlap checkpoints carry in-flight gossip that cannot be "
-            "resharded; drain the run synchronously first")
+    slots = _in_flight_slots(state)
     w = _ps_weight(state)
     if w.shape[0] != old_world:
         raise ValueError(f"state holds {w.shape[0]} rank rows, "
@@ -198,7 +244,9 @@ def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
     if not np.all(np.isfinite(w)) or np.any(w <= 0):
         raise ValueError(f"ps_weight must be finite and positive to "
                          f"de-bias the consensus; got {w}")
-    w_sum = float(w.sum())
+    # in-flight shares are mass in transit: fold each exactly once into
+    # both lanes of the consensus ratio (zero for drained checkpoints)
+    w_sum = float(w.sum()) + sum(float(sw.sum()) for _, sw in slots)
 
     def restack(row: np.ndarray, dtype) -> np.ndarray:
         return np.broadcast_to(
@@ -213,13 +261,20 @@ def reshard_state(state: dict, old_world: int, new_world: int) -> dict:
             return np.ones(new_world, arr.dtype)
         if path == ("gossip", "phase"):
             return np.zeros(new_world, arr.dtype)
+        if path[:2] == ("gossip", "in_flight"):
+            # folded into the consensus above; the new world's schedule
+            # starts with an empty FIFO of the same slot structure
+            return np.zeros((new_world,) + arr.shape[1:], arr.dtype)
         if path[:2] == ("gossip", "ef_residual"):
             # pending quantization correction is sender-local memory,
             # dropped safely at the boundary (see the docstring)
             return np.zeros((new_world,) + arr.shape[1:], arr.dtype)
         if path and path[0] == "params":
-            row = np.asarray(arr, np.float64).sum(0) / w_sum
-            return restack(row, arr.dtype)
+            num = np.asarray(arr, np.float64).sum(0)
+            for slot_params, _ in slots:
+                num = num + np.asarray(
+                    _leaf_at(slot_params, path[1:]), np.float64).sum(0)
+            return restack(num / w_sum, arr.dtype)
         if np.issubdtype(arr.dtype, np.floating):
             return restack(np.asarray(arr, np.float64).mean(0), arr.dtype)
         return restack(arr[0], arr.dtype)

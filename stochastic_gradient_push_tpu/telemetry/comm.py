@@ -163,6 +163,12 @@ class CommModel:
     wire_dtype: str = "f32"
     wire_block: int | None = None
     error_feedback: bool = False
+    # overlap provenance: the double-buffered phase schedule moves the
+    # SAME bytes as the sync round (every launched share is one wire
+    # round, consumed exactly once) — overlap changes wall-clock, never
+    # volume — so these fields only stamp the mode into snapshots
+    overlap: bool = False
+    staleness: int = 1
     wire_bytes_per_phase: tuple[int, ...] = ()
     ici_bytes_per_phase: tuple[int, ...] = ()
     dcn_bytes_per_phase: tuple[int, ...] = ()
@@ -176,7 +182,9 @@ class CommModel:
                       gossip_every: int = 1, global_avg_every: int = 0,
                       faults=None, ps_weight: bool = True,
                       interconnect=None, codec=None,
-                      error_feedback: bool = False) -> "CommModel":
+                      error_feedback: bool = False,
+                      overlap: bool = False,
+                      staleness: int = 1) -> "CommModel":
         """Model a push-sum/D-PSGD run over ``schedule``.
 
         ``payload_bytes`` must already be the ENCODED wire payload
@@ -192,6 +200,11 @@ class CommModel:
         single-lane ICI.  On a hierarchical schedule only the delegate
         (inter) messages ride the codec — the intra-slice grouped psum
         is exact, which is exactly how the collective layer compiles it.
+        ``overlap``/``staleness`` stamp the double-buffered phase
+        schedule into snapshots WITHOUT changing any lane: the
+        overlapped round launches the identical wire (each share sent
+        once, consumed once), so per-step bytes equal sync by
+        construction — only wall-clock moves.
         """
         wire_dtype = getattr(codec, "name", "f32") if codec else "f32"
         wire_block = getattr(codec, "block", None) if codec else None
@@ -255,6 +268,8 @@ class CommModel:
                        slice_size=fabric, hier=True,
                        wire_dtype=wire_dtype, wire_block=wire_block,
                        error_feedback=bool(error_feedback),
+                       overlap=bool(overlap),
+                       staleness=max(1, int(staleness)),
                        wire_bytes_per_phase=tuple(wire_l),
                        ici_bytes_per_phase=tuple(ici_l),
                        dcn_bytes_per_phase=tuple(dcn_l),
@@ -290,6 +305,8 @@ class CommModel:
                    slice_size=fabric,
                    wire_dtype=wire_dtype, wire_block=wire_block,
                    error_feedback=bool(error_feedback),
+                   overlap=bool(overlap),
+                   staleness=max(1, int(staleness)),
                    wire_bytes_per_phase=tuple(wire_l),
                    ici_bytes_per_phase=tuple(ici_l),
                    dcn_bytes_per_phase=tuple(dcn_l),
@@ -395,6 +412,8 @@ class CommModel:
                 "wire_dtype": self.wire_dtype,
                 "wire_block": self.wire_block,
                 "error_feedback": self.error_feedback,
+                "overlap": self.overlap,
+                "staleness": self.staleness,
                 "ici_bytes_per_phase": list(self.ici_bytes_per_phase),
                 "dcn_bytes_per_phase": list(self.dcn_bytes_per_phase)}
 

@@ -68,6 +68,87 @@ class GossipSchedule:
                     self.edge_weights[p, i, src]
         return w
 
+    def overlap_schedule(self, staleness: int = 1) -> "GossipSchedule":
+        """The double-buffered overlap round as a schedule over the
+        AUGMENTED state space ``(x, f₁ … f_{s−1})`` — the one-round-stale
+        effective mixing matrix of OSGP's phase schedule.
+
+        The compiled overlap round launches at the top of step ``t``
+        (``parallel/collectives.overlap_launch``) and consumes a share
+        launched ``staleness − 1`` steps earlier at the bottom
+        (``algorithms.post_step``), so per step with rotation phase
+        ``p`` the state evolves:
+
+        .. code-block:: text
+
+            x'   = L_p · x + f₁          (keep local share, consume oldest)
+            f'_k = f_{k+1}               (FIFO shift, k = 1 … s−2)
+            f'_{s−1} = O_p · x           (the just-launched incoming share)
+
+        where ``W_p = L_p + O_p`` splits the synchronous phase matrix
+        into its diagonal (self-weight) and off-diagonal (``ppermute``)
+        parts.  At ``staleness == 1`` the launch is consumed the same
+        step — the effective matrix is exactly ``W_p``, the payload one
+        optimizer update stale — and this method returns the schedule's
+        own tables.  For deeper FIFOs it materializes the block
+        transition as a plain :class:`GossipSchedule` over
+        ``world_size × staleness`` augmented ranks — rank ``k·n + r`` is
+        rank ``r``'s in-flight slot ``k`` (block 0 is the live parameter
+        block) — so ``analysis.verify_schedule`` checks the overlap
+        invariants with the SAME rules as synchronous schedules: every
+        sub-round a bijection, every column summing to 1 (push-sum mass
+        conservation *including in-flight shares*), and the
+        rotation-cycle product an ergodic contraction (the
+        staleness-shifted product of "The Algorithm of Pipelined
+        Gossiping"); rule SGPV106 sweeps this object for every
+        registered flat topology.  Sub-round ``i`` maps block 0 through
+        ``perm_i`` into block ``s−1`` and every in-flight block one step
+        forward; the shift edges carry weight 1 in sub-round 0 only.
+
+        Hierarchical schedules do not reduce to this block form (their
+        compiled overlap round composes the deferred delegate share with
+        an undeferred intra-slice ``psum``); they raise here and their
+        overlap invariants are pinned numerically by the collective-layer
+        tests instead.
+        """
+        if staleness < 1:
+            raise ValueError(f"staleness must be >= 1, got {staleness}")
+        if getattr(self, "phase_kinds", None):
+            raise ValueError(
+                "overlap_schedule applies to flat schedules; the "
+                "hierarchical overlap round composes the deferred "
+                "delegate share with an intra-slice psum and has no "
+                "single augmented table form")
+        if staleness == 1:
+            return self  # same-step consume: the effective matrix is W
+        n, s = self.world_size, staleness
+        blocks = s - 1               # in-flight FIFO blocks
+        ppi = max(self.peers_per_itr, 1)
+        big = n * s
+        perms = np.empty((self.num_phases, ppi, big), dtype=np.int32)
+        self_w = np.zeros((self.num_phases, big), dtype=np.float64)
+        edge_w = np.zeros((self.num_phases, ppi, big), dtype=np.float64)
+        ranks = np.arange(n)
+        for p in range(self.num_phases):
+            self_w[p, :n] = self.self_weight[p]
+            for i in range(ppi):
+                # block 0 launches through perm_i into the newest slot
+                if i < self.peers_per_itr:
+                    perms[p, i, :n] = blocks * n + self.perms[p, i]
+                    edge_w[p, i, :n] = self.edge_weights[p, i]
+                else:  # peers_per_itr == 0 (world 1): identity padding
+                    perms[p, i, :n] = blocks * n + ranks
+                # in-flight blocks shift one step forward (slot 1 →
+                # block 0: the consume); the shift rides sub-round 0 only
+                for k in range(1, blocks + 1):
+                    perms[p, i, k * n:(k + 1) * n] = (k - 1) * n + ranks
+                    if i == 0:
+                        edge_w[p, i, k * n:(k + 1) * n] = 1.0
+        return GossipSchedule(
+            perms=perms, self_weight=self_w, edge_weights=edge_w,
+            regular=False, world_size=big, peers_per_itr=ppi,
+            num_phases=self.num_phases)
+
 
 def build_schedule(graph: GraphTopology,
                    mixing: MixingStrategy | None = None) -> GossipSchedule:

@@ -420,9 +420,12 @@ def build_lm_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             # expert slices, so health composes with the flat dp/sp
             # meshes only; the CLI enforces that.)
             from ..resilience.monitor import health_signals
+            # the overlap FIFO rides along so the monitor observes the
+            # DRAINED view (in-flight mass is not a leak)
             metrics.update(health_signals(
                 params, grads, gstate.ps_weight, health_axis,
-                ef_residual=gstate.ef_residual))
+                ef_residual=gstate.ef_residual,
+                in_flight=gstate.in_flight))
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state, gossip=gstate), metrics
 

@@ -60,7 +60,13 @@ class TrainerConfig:
     # bounded staleness for overlap mode: in-flight gossip is consumed
     # synch_freq+1 steps after launch (≙ synch_freq, distributed.py:127-129)
     synch_freq: int = 0
-    # gossip on every k-th step (communication thinning, sync mode)
+    # first-class spelling of the overlap staleness bound: the FIFO depth
+    # (a share launched at step t is consumed at step t+staleness−1;
+    # staleness 1 = same-step consume, the ppermute hidden behind this
+    # step's compute).  0 = derive from synch_freq (synch_freq + 1)
+    staleness: int = 0
+    # gossip on every k-th step (communication thinning; composes with
+    # overlap — non-firing steps launch nothing)
     gossip_every: int = 1
     # exact global average (one allreduce) every k-th step, 0 = off —
     # the periodic-global-averaging recovery the planner emits for
@@ -262,10 +268,10 @@ class Trainer:
                 residual_floor=config.residual_floor, log=self.log,
                 registry=self.telemetry.registry)
             if not (config.all_reduce or config.bilat
-                    or config.bilat_async or config.overlap):
-                # overlap mode monitors but never auto-averages (the
-                # in-flight shares would be double-counted); the health
-                # stream still flags excursions for the operator
+                    or config.bilat_async):
+                # overlap runs recover too: the reactive average folds
+                # the in-flight FIFO into Σx/Σw and drains it, so
+                # nothing is double-counted (resilience/recovery.py)
                 from ..topology import topology_name
 
                 try:
@@ -337,6 +343,31 @@ class Trainer:
         return {**codec.to_dict(),
                 "error_feedback": bool(self.cfg.error_feedback)}
 
+    def _resolve_staleness(self) -> int:
+        """The overlap FIFO depth from the first-class ``staleness`` knob
+        or the reference-compat ``synch_freq`` alias (staleness =
+        synch_freq + 1); conflicting values fail fast."""
+        cfg = self.cfg
+        if cfg.staleness and cfg.synch_freq \
+                and cfg.staleness != cfg.synch_freq + 1:
+            raise ValueError(
+                f"staleness={cfg.staleness} conflicts with "
+                f"synch_freq={cfg.synch_freq} (staleness = synch_freq "
+                "+ 1); set one of the two")
+        staleness = cfg.staleness or (cfg.synch_freq + 1)
+        if staleness < 1:
+            raise ValueError("staleness must be >= 1")
+        if not cfg.overlap:
+            if staleness > 1:
+                # the reference likewise only reads synch_freq under
+                # overlap (distributed.py:578); accept-and-ignore keeps
+                # launch scripts flag-compatible
+                self.log.warning(
+                    "staleness/synch_freq is ignored without overlap "
+                    "mode")
+            return 1
+        return staleness
+
     def make_algorithm(self, ppi: int) -> GossipAlgorithm:
         cfg = self.cfg
         axis = self.gossip_axis
@@ -387,12 +418,7 @@ class Trainer:
                 # banner per run is enough
                 self.log.warning("gossip faults: %s", plan.summary())
                 self._logged_faults = True
-        staleness = (cfg.synch_freq + 1) if cfg.overlap else 1
-        if cfg.synch_freq and not cfg.overlap:
-            # the reference likewise only reads synch_freq under overlap
-            # (distributed.py:578); accept-and-ignore keeps launch scripts
-            # flag-compatible
-            self.log.warning("synch_freq is ignored without overlap mode")
+        staleness = self._resolve_staleness()
         if cfg.push_sum:
             return sgp(schedule, axis, overlap=cfg.overlap,
                        gossip_every=cfg.gossip_every,
@@ -473,7 +499,9 @@ class Trainer:
                 global_avg_every=alg.global_avg_every,
                 faults=alg.faults, ps_weight=cfg.push_sum,
                 interconnect=interconnect, codec=codec,
-                error_feedback=cfg.error_feedback)
+                error_feedback=cfg.error_feedback,
+                overlap=getattr(alg, "overlap", False),
+                staleness=getattr(alg, "staleness", 1))
         self.telemetry.attach_comm(model)
         self.telemetry.registry.emit("run_meta", {
             "world": self.gossip_world, "algorithm": alg_name,
@@ -696,6 +724,11 @@ class Trainer:
                 is_best = prec1 > best_prec1
                 best_prec1 = max(best_prec1, prec1)
                 if self.cluster is not None:
+                    # flush overlap in-flight shares before the save
+                    # barrier: the checkpoint (and the continuing run)
+                    # carry nothing in flight, so reshard/resume treat
+                    # it like a sync checkpoint
+                    state = self._drain_in_flight(state)
                     meta = self._ckpt_meta(epoch + 1, 0, best_prec1,
                                            begin_time, meters)
                     epoch_id = (None if cfg.overwrite_checkpoints else epoch)
@@ -780,6 +813,19 @@ class Trainer:
             meta["health"] = self.monitor.last_payload
         return meta
 
+    def _drain_in_flight(self, state):
+        """Flush overlap in-flight shares into params before a save
+        (algorithms.drain_state — the shared fold): each pending share
+        is consumed early (purely per-rank adds, no collective), so the
+        checkpoint carries nothing in flight and reshards/reloads like
+        a sync checkpoint.  The LIVE state adopts the drained view too,
+        so a resumed run and the continuing run follow the same
+        trajectory (consuming early is mass-conserving: the mean is
+        untouched, staleness momentarily shrinks)."""
+        from ..algorithms import drain_state
+
+        return drain_state(state)
+
     def _save_state(self, state):
         """What the checkpoint backend receives: global-state backends
         (orbax on a pod) take the live sharded arrays — every process
@@ -819,6 +865,7 @@ class Trainer:
             REQUEUE_EXIT_CODE)
         self._emit_exit_event("preempt-requeue", epoch, itr,
                               epoch * itr_per_epoch + itr)
+        state = self._drain_in_flight(state)  # nothing in flight on disk
         meta = self._ckpt_meta(epoch, itr, best_prec1, begin_time, meters)
         with self.telemetry.span("checkpoint_save", "checkpoint"):
             self.cluster.save_checkpoint(self._save_state(state), meta,
@@ -1082,11 +1129,20 @@ class Trainer:
                         and hasattr(alg, "global_average"):
                     with self.telemetry.span("recovery_global_average",
                                              "recovery"):
-                        new_p, new_w = self._recovery_fn(alg)(
-                            state.params, state.gossip.ps_weight)
-                        state = state.replace(
-                            params=new_p,
-                            gossip=state.gossip.replace(ps_weight=new_w))
+                        if getattr(alg, "overlap", False):
+                            # fold + drain the in-flight FIFO: pending
+                            # shares are counted exactly once in Σx/Σw
+                            new_p, new_w, new_fl = self._recovery_fn(
+                                alg)(state.params,
+                                     state.gossip.ps_weight,
+                                     state.gossip.in_flight)
+                            gossip = state.gossip.replace(
+                                ps_weight=new_w, in_flight=new_fl)
+                        else:
+                            new_p, new_w = self._recovery_fn(alg)(
+                                state.params, state.gossip.ps_weight)
+                            gossip = state.gossip.replace(ps_weight=new_w)
+                        state = state.replace(params=new_p, gossip=gossip)
                     if self.telemetry.comm is not None:
                         self.telemetry.comm.on_recovery()
         return state

@@ -6,12 +6,16 @@ hook (query + de-bias), backward hook (bias), optimizer step, transfer, and
 the gossip thread's mix all become one XLA program per rank
 (SURVEY.md §3.1).  The loop body does:
 
-    pre_step  → consume in-flight gossip (overlap)
+    pre_step  → overlap: LAUNCH round t's ppermute at the top of the
+                step, so XLA schedules the collective behind the
+                forward/backward (sync: no-op)
     eval      → de-biased params  →  forward/backward (bf16-friendly)
     reduce    → exact local/AR gradient averaging
     SGD       → torch-compatible update on the numerator params, LR from the
                 compiled schedule
-    post_step → gossip round (ppermute over ICI)
+    post_step → sync: the gossip round (ppermute over ICI);
+                overlap: consume the round launched staleness−1 steps
+                ago at the bottom of the step
 
 Everything is sharded over the gossip mesh axis with ``shard_map``: each
 rank holds its own model replica (leading world dimension), its own batch
@@ -174,11 +178,14 @@ def build_train_step(model, algorithm: GossipAlgorithm, tx, lr_schedule,
             # consensus health AFTER the gossip round: the signals see the
             # state the next step will train on.  Already identical across
             # ranks (each is a collective), so the local-axis pmean above
-            # must not re-average them — append afterwards.
+            # must not re-average them — append afterwards.  The overlap
+            # FIFO rides along so the monitor observes the DRAINED view
+            # (in-flight mass is not a leak).
             from ..resilience.monitor import health_signals
             metrics.update(health_signals(
                 params, grads, gstate.ps_weight, health_axis,
-                ef_residual=gstate.ef_residual))
+                ef_residual=gstate.ef_residual,
+                in_flight=gstate.in_flight))
         new_state = state.replace(
             step=state.step + 1, params=params, batch_stats=batch_stats,
             opt_state=opt_state, gossip=gstate)

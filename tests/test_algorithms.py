@@ -205,9 +205,13 @@ def test_osgp_mass_conservation_with_in_flight(mesh):
         z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=1e-3)
 
 
-def test_osgp_one_step_staleness_vs_sync(mesh):
-    """After one step, overlap mode holds back exactly the incoming share:
-    params_osgp + in_flight == params_sync."""
+def test_osgp_one_round_stale_vs_sync(mesh):
+    """The double-buffered round's one-round staleness, exactly: at
+    staleness 1 the launch (pre_step) ships w_i·x_t BEFORE the gradient
+    update and the consume (post_step) lands after it, so
+    x_{t+1} = W·x_t − lr·∇f(x_t)  — the gradient rides OUTSIDE the
+    mixing, vs sync's W·(x_t − lr·∇f).  The FIFO is fully drained at
+    every step boundary (nothing stays in flight across steps)."""
     graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
     sched = build_schedule(graph)
     lr = 0.05
@@ -221,53 +225,131 @@ def test_osgp_one_step_staleness_vs_sync(mesh):
 
     p_sync, _ = f_sync(X0, gs_sync, TARGETS)
     p_over, gs_over = f_over(X0, gs_over, TARGETS)
-    in_p, _ = gs_over.in_flight[0]
-    np.testing.assert_allclose(np.asarray(p_over) + np.asarray(in_p),
-                               np.asarray(p_sync), rtol=1e-5, atol=1e-6)
+    W = sched.mixing_matrix(0)
+    grad = X0 - TARGETS
+    np.testing.assert_allclose(np.asarray(p_over),
+                               W @ X0 - lr * grad, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_sync),
+                               W @ (X0 - lr * grad), rtol=1e-5, atol=1e-6)
+    # staleness 1 consumes the same-step launch: FIFO empty between steps
+    np.testing.assert_allclose(np.asarray(gs_over.in_flight[0][0]), 0.0,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(gs_over.ps_weight),
+                               np.ones(WORLD), rtol=1e-5)
 
 
-def test_osgp_val_params_drains_to_sync(mesh):
-    """Validation parity with the reference's ``model.eval()`` drain
-    (distributed.py:322-327): at staleness 1 the local+incoming split is
-    exact, so OSGP's TRAINING trajectory as seen by the forward is
-    identical to sync SGP's — and ``val_params`` (which drains the
-    in-flight share before de-biasing) must therefore equal sync SGP's
-    eval view at every step.  ``eval_params`` alone (undrained) must
-    NOT, or the overlap buffer would be vacuous."""
+def test_osgp_matches_augmented_numpy_simulator(mesh):
+    """Bit-level pin of the phase schedule at staleness 1–3: the compiled
+    overlap trajectory equals the AUGMENTED one-round-stale matrix model
+    (GossipSchedule.overlap_schedule — the SGPV106 object) applied to the
+    stacked state (x, f₁ … f_s), with the gradient entering the x block
+    only.  This is the jit-vs-numpy equality for the double-buffered
+    round."""
     graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
     sched = build_schedule(graph)
     lr = 0.05
-    alg_s = sgp(sched, GOSSIP_AXIS)
-    alg_o = osgp(sched, GOSSIP_AXIS)
-    f_sync = make_runner(alg_s, mesh, lr)
-    f_over = make_runner(alg_o, mesh, lr)
+    for staleness in (1, 2, 3):
+        alg = osgp(sched, GOSSIP_AXIS, staleness=staleness)
+        f = make_runner(alg, mesh, lr)
+        aug = sched.overlap_schedule(staleness)
+        assert aug.world_size == WORLD * staleness
+        params = X0.copy()
+        gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+        # augmented state: block 0 = params, block k = in-flight slot k;
+        # the push-sum weight lane follows the SAME augmented recursion
+        # (at staleness > 1 in-flight mass keeps w != 1 between steps)
+        y = np.zeros((WORLD * staleness, DIM))
+        y[:WORLD] = X0.astype(np.float64)
+        yw = np.zeros(WORLD * staleness)
+        yw[:WORLD] = 1.0
+        for step_i in range(2 * staleness + 3):
+            params, gstate = f(params, gstate, TARGETS)
+            jax.block_until_ready(params)
+            # the gradient is taken at the de-biased x_t/w_t (the
+            # launch's local rescale cancels in x/w) and applied to the
+            # live numerator block only — outside the mixing, one round
+            # stale
+            grad = y[:WORLD] / yw[:WORLD, None] - TARGETS
+            A = aug.mixing_matrix(step_i)
+            y = A @ y
+            yw = A @ yw
+            y[:WORLD] -= lr * grad
+            np.testing.assert_allclose(
+                np.asarray(params), y[:WORLD], rtol=1e-5, atol=1e-5,
+                err_msg=f"staleness {staleness} step {step_i}")
+            # FIFO slots 0..s-2 mirror augmented blocks 1..s-1; the
+            # tail slot is always empty between steps (freed for the
+            # next launch)
+            for k in range(staleness - 1):
+                np.testing.assert_allclose(
+                    np.asarray(gstate.in_flight[k][0]),
+                    y[(k + 1) * WORLD:(k + 2) * WORLD],
+                    rtol=1e-5, atol=1e-5,
+                    err_msg=f"staleness {staleness} slot {k} "
+                            f"step {step_i}")
+            np.testing.assert_allclose(
+                np.asarray(gstate.in_flight[-1][0]), 0.0, atol=1e-7)
 
-    def val_view(alg):
-        return jax.jit(jax.shard_map(
-            alg.val_params, mesh=mesh,
-            in_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
-            out_specs=P(GOSSIP_AXIS)))
 
-    vs, vo = val_view(alg_s), val_view(alg_o)  # jit once, not per step
-    p_s = X0.copy()
-    p_o = X0.copy()
-    gs_s = stack_state(alg_s.init(jnp.zeros((DIM,), jnp.float32)))
-    gs_o = stack_state(alg_o.init(jnp.zeros((DIM,), jnp.float32)))
-    for k in range(7):
-        p_s, gs_s = f_sync(p_s, gs_s, TARGETS)
-        jax.block_until_ready(p_s)
-        p_o, gs_o = f_over(p_o, gs_o, TARGETS)
-        jax.block_until_ready(p_o)
-        z_sync = np.asarray(vs(p_s, gs_s))
-        z_oval = np.asarray(vo(p_o, gs_o))
-        np.testing.assert_allclose(z_oval, z_sync, rtol=1e-5, atol=1e-6,
+def test_osgp_val_params_drains_in_flight(mesh):
+    """Validation view ≙ the reference's ``model.eval()`` drain
+    (distributed.py:322-327): ``val_params`` folds every in-flight share
+    into the de-bias.  At staleness 1 the FIFO is empty between steps, so
+    ``val_params == eval_params``; at staleness 2 a real share is in
+    flight — the drained view must equal the hand-drained
+    ``(x + Σ slots) / (w + Σ slot_w)``, differ from the undrained eval,
+    and (lr=0) its mass-weighted mean must equal the initial mean
+    exactly (nothing in flight is lost or double-counted)."""
+    graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
+    sched = build_schedule(graph)
+
+    def views(alg):
+        spec = (P(GOSSIP_AXIS), P(GOSSIP_AXIS))
+        val = jax.jit(jax.shard_map(alg.val_params, mesh=mesh,
+                                    in_specs=spec,
+                                    out_specs=P(GOSSIP_AXIS)))
+        ev = jax.jit(jax.shard_map(alg.eval_params, mesh=mesh,
+                                   in_specs=spec,
+                                   out_specs=P(GOSSIP_AXIS)))
+        return val, ev
+
+    # staleness 1: nothing in flight between steps — val == eval
+    alg1 = osgp(sched, GOSSIP_AXIS)
+    f1 = make_runner(alg1, mesh, lr=0.05)
+    val1, ev1 = views(alg1)
+    p = X0.copy()
+    gs = stack_state(alg1.init(jnp.zeros((DIM,), jnp.float32)))
+    for k in range(3):
+        p, gs = f1(p, gs, TARGETS)
+        jax.block_until_ready(p)
+        np.testing.assert_allclose(np.asarray(val1(p, gs)),
+                                   np.asarray(ev1(p, gs)),
+                                   rtol=1e-6, atol=1e-7,
                                    err_msg=f"step {k}")
-    # undrained eval differs (the buffer holds a real share)
-    z_oeval = np.asarray(jax.jit(jax.shard_map(
-        alg_o.eval_params, mesh=mesh,
-        in_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
-        out_specs=P(GOSSIP_AXIS)))(p_o, gs_o))
-    assert np.max(np.abs(z_oeval - z_sync)) > 1e-4
+
+    # staleness 2: one share is genuinely in flight across the boundary
+    alg2 = osgp(sched, GOSSIP_AXIS, staleness=2)
+    f2 = make_runner(alg2, mesh, lr=0.0)
+    val2, ev2 = views(alg2)
+    p = X0.copy()
+    gs = stack_state(alg2.init(jnp.zeros((DIM,), jnp.float32)))
+    for _ in range(5):
+        p, gs = f2(p, gs, TARGETS)
+        jax.block_until_ready(p)
+    drained_p = np.asarray(p).astype(np.float64)
+    drained_w = np.asarray(gs.ps_weight).astype(np.float64)
+    for in_p, in_w in gs.in_flight:
+        drained_p = drained_p + np.asarray(in_p)
+        drained_w = drained_w + np.asarray(in_w).reshape(drained_w.shape)
+    want = drained_p / drained_w.reshape(WORLD, 1)
+    z_val = np.asarray(val2(p, gs))
+    np.testing.assert_allclose(z_val, want, rtol=1e-5, atol=1e-6)
+    # the undrained eval differs (the buffer holds a real share)
+    assert np.max(np.abs(np.asarray(ev2(p, gs)) - z_val)) > 1e-4
+    # and total mass (numerator and weight lanes) is exactly conserved
+    np.testing.assert_allclose(drained_p.sum(axis=0), X0.sum(axis=0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(drained_w.sum(), WORLD, rtol=1e-6)
 
 
 @pytest.mark.parametrize("staleness", [2, 3])
@@ -307,36 +389,43 @@ def test_osgp_bounded_staleness(mesh, staleness):
 
 
 def test_osgp_staleness_consumes_oldest_first(mesh):
-    """With staleness=2, after exactly two steps the round launched at
-    step 0 (and only it) has been folded back in."""
+    """With staleness=2, the share launched at the top of step t is
+    consumed at the bottom of step t+1 — "round t−1's payload mixed in
+    at the bottom" — and the FIFO tail is always the freed slot."""
     graph = NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1)
     sched = build_schedule(graph)
     alg = osgp(sched, GOSSIP_AXIS, staleness=2)
     f = make_runner(alg, mesh, lr=0.0)
     gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
 
+    def off_diag(phase):
+        W = sched.mixing_matrix(phase)
+        return W - np.diag(np.diag(W))
+
+    # step 1: pre launches round 0 (head slot), post pops the empty
+    # tail's predecessor — nothing old enough yet, round 0 stays
     p, gs = f(X0, gstate, TARGETS)
-    # slot 0 empty (nothing old enough yet), slot 1 = round 0's incoming
-    np.testing.assert_allclose(np.asarray(gs.in_flight[0][0]), 0.0,
+    np.testing.assert_allclose(np.asarray(gs.in_flight[0][0]),
+                               off_diag(0) @ X0, rtol=1e-5, atol=1e-6,
+                               err_msg="round 0's share should be the "
+                                       "oldest in-flight slot")
+    np.testing.assert_allclose(np.asarray(gs.in_flight[1][0]), 0.0,
                                atol=1e-7)
-    assert np.abs(np.asarray(gs.in_flight[1][0])).max() > 0
 
-    # step 2 consumes slot 0 (still empty) and shifts round 0's share to
-    # the front; round 1's share takes the freed last slot
+    # step 2: pre launches round 1 into the freed tail, post consumes
+    # round 0's share (launched exactly staleness−1 = 1 step ago)
+    x1 = np.asarray(p).astype(np.float64)
     p2, gs2 = f(p, gs, TARGETS)
-    assert np.abs(np.asarray(gs2.in_flight[0][0])).max() > 0
-    assert np.abs(np.asarray(gs2.in_flight[1][0])).max() > 0
-
-    # step 3 folds round 0's share (launched at step 0) back into params:
-    # the round trip took exactly `staleness` = 2 steps
-    mass_before = (np.asarray(p2).sum(axis=0)
-                   + sum(np.asarray(b).sum(axis=0)
-                         for b, _ in gs2.in_flight))
-    p3, gs3 = f(p2, gs2, TARGETS)
-    mass_after = (np.asarray(p3).sum(axis=0)
-                  + sum(np.asarray(b).sum(axis=0)
-                        for b, _ in gs3.in_flight))
-    np.testing.assert_allclose(mass_after, mass_before, rtol=1e-4)
+    lo1 = np.diag(sched.mixing_matrix(1))
+    want = lo1[:, None] * x1 + off_diag(0) @ X0
+    np.testing.assert_allclose(np.asarray(p2), want, rtol=1e-5,
+                               atol=1e-6,
+                               err_msg="step 2 must fold round 0's "
+                                       "share back in")
+    np.testing.assert_allclose(np.asarray(gs2.in_flight[0][0]),
+                               off_diag(1) @ x1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gs2.in_flight[1][0]), 0.0,
+                               atol=1e-7)
 
 
 def test_bilat_step_is_exact_pair_average(mesh):

@@ -1,0 +1,474 @@
+"""Overlap (OSGP) as a first-class phase schedule: the interaction matrix.
+
+The double-buffered round — launch at the top of the step
+(``collectives.overlap_launch``), consume at the bottom — must compose
+with everything the synchronous round composes with: fault injection
+(masks keyed on the LAUNCH tick), wire codecs + error feedback (the
+residual telescopes against the SENT round), communication thinning,
+periodic/reactive exact averaging (fold + drain the FIFO), hierarchical
+two-level schedules (only the delegate share defers), and the comm
+accountant (bytes identical to sync — overlap moves wall-clock, not
+volume).  Every compiled check here serializes dispatch per the OSGP
+deadlock note (CHANGES.md PR 8): XLA CPU in-process collectives hang
+when many executions are in flight concurrently.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stochastic_gradient_push_tpu.algorithms import sgp
+from stochastic_gradient_push_tpu.analysis import verify_schedule
+from stochastic_gradient_push_tpu.parallel import (
+    GOSSIP_AXIS,
+    make_gossip_mesh,
+)
+from stochastic_gradient_push_tpu.parallel.wire import Int8Codec
+from stochastic_gradient_push_tpu.resilience import parse_fault_spec
+from stochastic_gradient_push_tpu.topology import (
+    GRAPH_TOPOLOGIES,
+    HierarchicalGraph,
+    NPeerDynamicDirectedExponentialGraph,
+    build_schedule,
+)
+
+WORLD = 8
+DIM = 6
+
+rng = np.random.default_rng(7)
+X0 = rng.normal(size=(WORLD, DIM)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_gossip_mesh(WORLD)
+
+
+def stack_state(state):
+    return jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a),
+                                  (WORLD,) + np.shape(a)).copy(),
+        state)
+
+
+def make_avg_runner(alg, mesh):
+    """Jitted pure-averaging step (lr=0): pre_step → post_step."""
+
+    def step(params, gstate):
+        params, gstate = alg.pre_step(params, gstate)
+        return alg.post_step(params, gstate)
+
+    return jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS)),
+        out_specs=(P(GOSSIP_AXIS), P(GOSSIP_AXIS))))
+
+
+def total_mass(params, gstate, residual=False):
+    """Σ over ranks of params + every in-flight slot (+ EF residual)."""
+    tot = np.asarray(params, np.float64).sum(axis=0)
+    for in_p, _ in gstate.in_flight:
+        tot = tot + np.asarray(in_p, np.float64).sum(axis=0)
+    if residual and gstate.ef_residual is not None:
+        tot = tot + np.asarray(gstate.ef_residual, np.float64).sum(axis=0)
+    return tot
+
+
+def weight_mass(gstate):
+    w = np.asarray(gstate.ps_weight, np.float64).sum()
+    for _, in_w in gstate.in_flight:
+        w += np.asarray(in_w, np.float64).sum()
+    return w
+
+
+def debias(params, gstate):
+    w = np.asarray(gstate.ps_weight).reshape(WORLD, 1)
+    return np.asarray(params) / w
+
+
+# -- acceptance: the verifier takes the overlap schedule everywhere ---------
+
+def test_overlap_schedule_verifies_for_all_flat_topologies():
+    """``analysis.verify_schedule`` accepts the one-round-stale augmented
+    matrix (column-stochastic + contracting) for EVERY registered flat
+    topology at world 2–64, staleness 1–3 — the SGPV106 object."""
+    classes = sorted({c for c in GRAPH_TOPOLOGIES.values()
+                      if c is not None and c is not HierarchicalGraph},
+                     key=lambda c: c.__name__)
+    checked = 0
+    for cls in classes:
+        for world in (2, 3, 4, 5, 6, 8, 12, 16, 24, 32, 48, 64):
+            for ppi in (1, 2):
+                try:
+                    graph = cls(world, peers_per_itr=ppi)
+                except ValueError:
+                    continue  # unsupported cell, same skip as the sweep
+                sched = build_schedule(graph)
+                for s in (1, 2, 3):
+                    ov = sched.overlap_schedule(s)
+                    assert ov.world_size == world * s
+                    findings, gap = verify_schedule(
+                        ov, f"{cls.__name__}(w={world}, ppi={ppi}, "
+                            f"staleness={s})", "<test>", 1)
+                    assert not findings, [str(f) for f in findings]
+                    assert np.isfinite(gap) and (world == 1 or gap > 0)
+                    checked += 1
+    assert checked > 100  # the sweep actually covered the grid
+
+
+def test_overlap_schedule_validation():
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    with pytest.raises(ValueError, match="staleness"):
+        sched.overlap_schedule(0)
+    assert sched.overlap_schedule(1) is sched  # same-step consume = W
+    hier = build_schedule(HierarchicalGraph(WORLD))
+    with pytest.raises(ValueError, match="hierarchical"):
+        hier.overlap_schedule(2)
+
+
+# -- overlap × fault injection ----------------------------------------------
+
+def test_overlap_drop_mass_conservation(mesh):
+    """overlap + ``drop:S->D``: masks are resolved at the LAUNCH tick, the
+    sender reabsorbs the undelivered weight when the wire fires, and the
+    dropped share rides the FIFO as an exact zero — so total mass
+    (params + in-flight, both lanes) is conserved at every step and the
+    de-biased consensus still lands on the true initial mean."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    plan = parse_fault_spec("drop:0->1@2:6;drop:3->5;seed:3")
+    masks = plan.build_masks(sched)
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, staleness=2,
+              faults=masks)
+    f = make_avg_runner(alg, mesh)
+
+    params = X0.copy()
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+    want = X0.astype(np.float64).sum(axis=0)
+    for t in range(30):
+        params, gstate = jax.block_until_ready(f(params, gstate))
+        np.testing.assert_allclose(total_mass(params, gstate), want,
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"step {t}")
+        np.testing.assert_allclose(weight_mass(gstate), WORLD,
+                                   rtol=1e-5, err_msg=f"step {t}")
+    for _ in range(170):
+        params, gstate = jax.block_until_ready(f(params, gstate))
+    z = debias(params, gstate)
+    np.testing.assert_allclose(
+        z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=2e-3)
+
+
+# -- overlap × int8 wire × error feedback -----------------------------------
+
+def test_overlap_int8_ef_telescoping(mesh):
+    """overlap + int8 + EF: the residual telescopes against the SENT
+    round, so ``Σ(params + in-flight + residual)`` is EXACTLY the
+    uncompressed mass at every step (delivered + pending == exact
+    mixing), the never-quantized ps-weight lane matches the f32 overlap
+    run, and consensus lands within quantization tolerance of the mean."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, staleness=2,
+              wire=Int8Codec(block=16), error_feedback=True)
+    ref = sgp(sched, GOSSIP_AXIS, overlap=True, staleness=2)
+    f = make_avg_runner(alg, mesh)
+    f_ref = make_avg_runner(ref, mesh)
+
+    params = X0.copy()
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+    assert gstate.ef_residual is not None
+    p_ref = X0.copy()
+    g_ref = stack_state(ref.init(jnp.zeros((DIM,), jnp.float32)))
+    want = X0.astype(np.float64).sum(axis=0)
+    for t in range(40):
+        params, gstate = jax.block_until_ready(f(params, gstate))
+        p_ref, g_ref = jax.block_until_ready(f_ref(p_ref, g_ref))
+        # the telescoping identity: quantization error lives in the
+        # residual, never in the network mass
+        np.testing.assert_allclose(
+            total_mass(params, gstate, residual=True), want,
+            rtol=1e-4, atol=1e-4, err_msg=f"step {t}")
+        # the ps-weight lane never goes through the codec: identical
+        # trajectory to the uncompressed overlap run
+        np.testing.assert_allclose(np.asarray(gstate.ps_weight),
+                                   np.asarray(g_ref.ps_weight),
+                                   rtol=1e-6, atol=1e-7,
+                                   err_msg=f"step {t}")
+    z = debias(params, gstate)
+    np.testing.assert_allclose(
+        z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=5e-2)
+    # the pending residual stays bounded (EF, not a leak)
+    assert np.abs(np.asarray(gstate.ef_residual)).max() < 1.0
+
+
+# -- overlap × thinning ------------------------------------------------------
+
+def test_overlap_thinning_matches_numpy(mesh):
+    """overlap + ``gossip_every=2`` at staleness 1: firing steps apply
+    the rotation's W exactly (same-step launch+consume), non-firing
+    steps are the identity, and the rotation advances only with fired
+    rounds — the same clock as the sync thinned path."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, gossip_every=2)
+    f = make_avg_runner(alg, mesh)
+
+    params = X0.copy()
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+    sim = X0.astype(np.float64).copy()
+    for t in range(9):
+        params, gstate = jax.block_until_ready(f(params, gstate))
+        if t % 2 == 0:
+            sim = sched.mixing_matrix(t // 2) @ sim
+        np.testing.assert_allclose(np.asarray(params), sim,
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"step {t}")
+
+
+# -- overlap × periodic exact averaging --------------------------------------
+
+def test_overlap_global_avg_folds_and_drains(mesh):
+    """overlap + ``global_avg_every``: the fired average folds the
+    in-flight FIFO into Σx/Σw and drains it — at lr=0 every rank snaps
+    to EXACTLY the initial mean (in-flight mass included), ps-weight
+    resets to 1, and the FIFO is empty."""
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, staleness=2,
+              global_avg_every=3)
+    f = make_avg_runner(alg, mesh)
+
+    params = X0.copy()
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+    for t in range(3):  # steps 0,1,2; the average fires at tick_next=3
+        params, gstate = jax.block_until_ready(f(params, gstate))
+    np.testing.assert_allclose(
+        np.asarray(params),
+        np.broadcast_to(X0.mean(axis=0), (WORLD, DIM)),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gstate.ps_weight),
+                               np.ones(WORLD), rtol=1e-6)
+    for in_p, in_w in gstate.in_flight:
+        np.testing.assert_allclose(np.asarray(in_p), 0.0, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(in_w), 0.0, atol=1e-7)
+
+
+# -- overlap × hierarchical two-level schedule -------------------------------
+
+def test_hierarchical_overlap_mass_and_consensus(mesh):
+    """overlap on the two-level schedule: only the delegate (DCN) share
+    defers; the ICI-local intra-slice psum runs at consume time.  Mass
+    (params + in-flight, both lanes) is conserved every step and the
+    de-biased consensus reaches the initial mean — the invariant the
+    augmented-table form cannot express is pinned numerically here."""
+    sched = build_schedule(HierarchicalGraph(WORLD))
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, staleness=2)
+    f = make_avg_runner(alg, mesh)
+
+    params = X0.copy()
+    gstate = stack_state(alg.init(jnp.zeros((DIM,), jnp.float32)))
+    want = X0.astype(np.float64).sum(axis=0)
+    for t in range(20):
+        params, gstate = jax.block_until_ready(f(params, gstate))
+        np.testing.assert_allclose(total_mass(params, gstate), want,
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"step {t}")
+        np.testing.assert_allclose(weight_mass(gstate), WORLD,
+                                   rtol=1e-5, err_msg=f"step {t}")
+    for _ in range(60):
+        params, gstate = jax.block_until_ready(f(params, gstate))
+    z = debias(params, gstate)
+    np.testing.assert_allclose(
+        z, np.broadcast_to(X0.mean(axis=0), z.shape), atol=2e-3)
+
+
+# -- checkpoint: drain at save, reshard like sync ----------------------------
+
+def test_overlap_checkpoint_drains_and_reshards(tmp_path, mesh):
+    """A formerly-overlap Trainer checkpoint: the save barrier drains the
+    in-flight FIFO into params (satellite: supervise/reshard.py used to
+    reject these), so the on-disk state carries zero slots and reshards
+    to a smaller world with the mean preserved."""
+    from stochastic_gradient_push_tpu.data import (
+        DistributedSampler, ShardedLoader, synthetic_classification)
+    from stochastic_gradient_push_tpu.models import TinyMLP
+    from stochastic_gradient_push_tpu.supervise import (
+        consensus_mean, load_world_checkpoint, reshard_state)
+    from stochastic_gradient_push_tpu.train.loop import (
+        Trainer, TrainerConfig)
+    from stochastic_gradient_push_tpu.utils.checkpoint import (
+        CheckpointManager, ClusterManager)
+
+    batch, classes, img = 4, 4, 8
+    images, labels = synthetic_classification(
+        WORLD * batch * 2, num_classes=classes, image_size=img, seed=5)
+    cfg = TrainerConfig(
+        graph_class=NPeerDynamicDirectedExponentialGraph,
+        overlap=True, staleness=2, lr=0.1, batch_size=batch,
+        num_epochs=1, num_itr_ignore=0, checkpoint_dir=str(tmp_path),
+        num_classes=classes, verbose=False)
+    ckpt = CheckpointManager(str(tmp_path), world_size=WORLD)
+    trainer = Trainer(cfg, TinyMLP(num_classes=classes), mesh,
+                      sample_input_shape=(batch, img, img, 3),
+                      cluster_manager=ClusterManager(
+                          ckpt, install_handlers=False))
+    state = trainer.init_state()
+    sampler = DistributedSampler(len(images), WORLD)
+    loader = ShardedLoader(images, labels, batch, sampler)
+    live, _ = trainer.fit(state, loader, sampler, val_loader=None)
+
+    # the live state was drained at the save barrier too (the continuing
+    # run and a resumed run share one trajectory)
+    for in_p, in_w in live.gossip.in_flight:
+        for leaf in jax.tree.leaves(in_p):
+            np.testing.assert_allclose(np.asarray(leaf), 0.0, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(in_w), 0.0, atol=1e-7)
+
+    saved, _, _ = load_world_checkpoint(str(tmp_path), "", WORLD)
+    fifo = saved["gossip"]["in_flight"]
+    assert fifo and all(
+        not np.asarray(leaf).any()
+        for slot in fifo.values()
+        for _, leaf in _walk_leaves(slot))
+    before = consensus_mean(saved)
+    new = reshard_state(saved, WORLD, 4)
+    after = consensus_mean(new)
+    for k in before:
+        np.testing.assert_allclose(after[k], before[k], atol=1e-6,
+                                   err_msg=k)
+
+
+def _walk_leaves(tree, path=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk_leaves(v, path + (k,))
+    else:
+        yield path, tree
+
+
+# -- health monitoring sees the drained view ---------------------------------
+
+def test_overlap_health_signals_use_drained_view(mesh):
+    """At staleness ≥ 2, weight mass legitimately rides the FIFO across
+    the step boundary; the in-step health signals must fold it back in
+    or every overlap run reads as a push-sum mass leak (and
+    false-triggers reactive recovery).  Pin: ps_mass_err stays at float
+    noise through real overlap training steps."""
+    from stochastic_gradient_push_tpu.data import synthetic_classification
+    from stochastic_gradient_push_tpu.models import TinyMLP
+    from stochastic_gradient_push_tpu.train import (
+        LRSchedule, build_train_step, init_train_state, replicate_state,
+        sgd, shard_train_step)
+
+    batch, classes, img = 2, 4, 8
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, staleness=2)
+    model = TinyMLP(num_classes=classes)
+    tx = sgd(momentum=0.9)
+    step = build_train_step(
+        model, alg, tx,
+        LRSchedule(ref_lr=0.1, batch_size=batch, world_size=WORLD),
+        itr_per_epoch=10, num_classes=classes, health_axis=GOSSIP_AXIS)
+    fn = shard_train_step(step, mesh)
+    state = replicate_state(
+        init_train_state(model, jax.random.PRNGKey(0),
+                         jnp.zeros((batch, img, img, 3)), tx, alg),
+        WORLD)
+    images, labels = synthetic_classification(
+        WORLD * batch, num_classes=classes, image_size=img, seed=2)
+    x = images.reshape(WORLD, batch, img, img, 3)
+    y = labels.reshape(WORLD, batch)
+    for t in range(4):
+        state, metrics = fn(state, x, y)
+        jax.block_until_ready(state)
+        assert float(np.asarray(metrics["ps_mass_err"])[0]) < 1e-5, \
+            f"step {t}: in-flight weight mass read as a leak"
+        # the drained per-rank weights stay in a sane band too (no
+        # ps-weight-collapse false positive from the launch rescale)
+        assert float(np.asarray(metrics["ps_w_min"])[0]) > 0.2
+
+
+# -- comm accounting: bytes identical to sync --------------------------------
+
+def test_comm_model_overlap_prices_identically_to_sync():
+    from stochastic_gradient_push_tpu.telemetry import CommModel
+
+    sched = build_schedule(
+        NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=2))
+    payload = 4096
+    sync = CommModel.from_schedule(sched, payload, global_avg_every=4)
+    over = CommModel.from_schedule(sched, payload, global_avg_every=4,
+                                   overlap=True, staleness=3)
+    assert over.totals(50) == sync.totals(50)  # bytes don't change
+    d = over.to_dict()
+    assert d["overlap"] is True and d["staleness"] == 3
+    assert sync.to_dict()["overlap"] is False
+
+
+# -- CLI surface -------------------------------------------------------------
+
+class TestStalenessCLI:
+    def test_sgd_staleness_threads_and_validates(self):
+        from stochastic_gradient_push_tpu.run.gossip_sgd import (
+            parse_config)
+
+        cfg, _ = parse_config(["--dataset", "synthetic",
+                               "--overlap", "True", "--staleness", "3"])
+        assert cfg.overlap and cfg.staleness == 3
+        with pytest.raises(SystemExit, match="overlap-mode knob"):
+            parse_config(["--dataset", "synthetic", "--staleness", "2"])
+        with pytest.raises(SystemExit, match="must be >= 0"):
+            parse_config(["--dataset", "synthetic", "--overlap", "True",
+                          "--staleness", "-1"])
+        with pytest.raises(SystemExit, match="conflicts"):
+            parse_config(["--dataset", "synthetic", "--overlap", "True",
+                          "--staleness", "3", "--synch_freq", "3"])
+        # the synch_freq alias still resolves (staleness = synch_freq+1)
+        cfg, _ = parse_config(["--dataset", "synthetic",
+                               "--overlap", "True",
+                               "--staleness", "3", "--synch_freq", "2"])
+        assert cfg.staleness == 3
+
+    def test_lm_staleness_same_rejection_text(self, tmp_path):
+        """The LM CLI exposes --staleness with the SAME validation and
+        rejection text as the SGD harness (shared resolver)."""
+        from stochastic_gradient_push_tpu.run.gossip_lm import main
+
+        common = ["--world_size", str(WORLD), "--num_steps", "1",
+                  "--d_model", "16", "--n_layers", "1", "--n_heads", "2",
+                  "--d_ff", "32", "--seq_len", "16", "--batch_size", "2",
+                  "--checkpoint_dir", str(tmp_path)]
+        with pytest.raises(SystemExit, match="overlap-mode knob"):
+            main(common + ["--staleness", "2"])
+        with pytest.raises(SystemExit, match="must be >= 0"):
+            main(common + ["--overlap", "True", "--staleness", "-1"])
+
+    def test_trainer_resolves_staleness(self):
+        from stochastic_gradient_push_tpu.train.loop import (
+            Trainer, TrainerConfig)
+
+        mesh = make_gossip_mesh(WORLD)
+
+        def trainer(**over):
+            cfg = TrainerConfig(
+                graph_class=NPeerDynamicDirectedExponentialGraph,
+                checkpoint_dir="/tmp/x", verbose=False, **over)
+            return Trainer(cfg, model=None, mesh=mesh,
+                           sample_input_shape=(2, 8, 8, 3))
+
+        alg = trainer(overlap=True, staleness=3).make_algorithm(1)
+        assert alg.staleness == 3
+        alg = trainer(overlap=True, synch_freq=2).make_algorithm(1)
+        assert alg.staleness == 3  # alias: synch_freq + 1
+        with pytest.raises(ValueError, match="conflicts"):
+            trainer(overlap=True, staleness=2,
+                    synch_freq=3).make_algorithm(1)
+        # without overlap the knob is ignored with a warning (flag
+        # compatibility with reference launch scripts)
+        alg = trainer(staleness=3).make_algorithm(1)
+        assert alg.staleness == 1

@@ -512,23 +512,38 @@ class TestHierarchicalRanking:
             check_topology(64, HierarchicalGraph, ppi=1, algorithm="dpsgd",
                            interconnect=self._fabric())
 
-    @pytest.mark.parametrize("mode", ["overlap", "faults"])
-    def test_overlap_and_faults_runs_never_plan_hierarchical(self, mode):
-        # PushSumGossip rejects hierarchical schedules under overlap and
-        # fault injection; even on a DCN-dominant fabric the planner must
-        # rank hierarchical out instead of crashing the launch
-        cons = PlanConstraints(interconnect=self._fabric(),
-                               **{mode: True})
+    def test_faulted_runs_never_plan_hierarchical(self):
+        # PushSumGossip rejects hierarchical schedules under fault
+        # injection (the grouped psum has no per-edge mask); even on a
+        # DCN-dominant fabric the planner must rank hierarchical out
+        # instead of crashing the launch
+        cons = PlanConstraints(interconnect=self._fabric(), faults=True)
         plan = plan_for(64, ppi=1, constraints=cons)
         assert plan.topology != "hierarchical"
 
-    @pytest.mark.parametrize("mode", ["overlap", "faults"])
-    def test_forced_hierarchical_rejected_for_overlap_and_faults(self, mode):
+    def test_overlap_runs_may_plan_hierarchical(self):
+        # overlap composes with the hierarchical round now (the delegate
+        # share defers; the intra psum runs at consume), so the overlap
+        # constraint no longer filters the ranking: on a DCN-dominant
+        # fabric an overlap run gets the same winner as a sync run
+        cons = PlanConstraints(interconnect=self._fabric(), overlap=True)
+        plan = plan_for(64, ppi=1, constraints=cons)
+        sync = plan_for(64, ppi=1, constraints=PlanConstraints(
+            interconnect=self._fabric()))
+        assert plan.topology == sync.topology == "hierarchical"
+
+    def test_forced_hierarchical_rejected_for_faults_only(self):
         from stochastic_gradient_push_tpu.topology import HierarchicalGraph
 
-        with pytest.raises(ValueError, match="flat-schedule"):
+        with pytest.raises(ValueError, match="flat-schedule|flat "
+                                             "topology"):
             check_topology(64, HierarchicalGraph, ppi=1,
-                           interconnect=self._fabric(), **{mode: True})
+                           interconnect=self._fabric(), faults=True)
+        # forced hierarchical under overlap is accepted (and stays
+        # hierarchical)
+        plan = check_topology(64, HierarchicalGraph, ppi=1,
+                              interconnect=self._fabric(), overlap=True)
+        assert plan.topology == "hierarchical"
 
     def test_hierarchical_plan_graph_class_keeps_its_name(self):
         # Plan.graph_class binds slice_size via functools.partial; the

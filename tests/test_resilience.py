@@ -300,11 +300,19 @@ class TestFaultedGossip:
         with pytest.raises(ValueError, match="push-sum"):
             dpsgd(sched, GOSSIP_AXIS, faults=masks)
 
-    def test_overlap_rejects_faults(self):
+    def test_overlap_composes_with_faults(self):
+        # masks are keyed on the LAUNCH tick, so the overlap phase
+        # schedule takes fault plans like sync does (mass conservation
+        # under overlap+drop is pinned in tests/test_overlap.py)
         sched = _exp_schedule()
         masks = parse_fault_spec("drop:0->1@0:4").build_masks(sched)
-        with pytest.raises(ValueError, match="synchronous"):
-            sgp(sched, GOSSIP_AXIS, overlap=True, faults=masks)
+        alg = sgp(sched, GOSSIP_AXIS, overlap=True, faults=masks)
+        assert alg.overlap and alg.faults is masks
+        # the thinning cross-check still applies under overlap
+        masks2 = parse_fault_spec("drop:0->1@0:4").build_masks(
+            sched, gossip_every=2)
+        with pytest.raises(ValueError, match="gossip_every"):
+            sgp(sched, GOSSIP_AXIS, overlap=True, faults=masks2)
 
 
 # -- monitor -----------------------------------------------------------------
@@ -496,12 +504,31 @@ class TestRecovery:
         with pytest.raises(ValueError, match="global_average"):
             make_recovery_fn(all_reduce(GOSSIP_AXIS), mesh)
 
-    def test_recovery_fn_rejects_overlap(self, mesh):
-        """Same invariant as global_avg_every: averaging around in-flight
-        overlap shares would double-count them."""
-        alg = sgp(_exp_schedule(), GOSSIP_AXIS, overlap=True)
-        with pytest.raises(ValueError, match="double-counted"):
-            make_recovery_fn(alg, mesh)
+    def test_recovery_fn_folds_and_drains_overlap(self, mesh):
+        """The reactive average under overlap folds the in-flight FIFO
+        into Σx/Σw (each pending share counted exactly once) and drains
+        it — the exact mean survives, nothing is double-counted."""
+        alg = sgp(_exp_schedule(), GOSSIP_AXIS, overlap=True, staleness=2)
+        fn = make_recovery_fn(alg, mesh)
+        rng = np.random.default_rng(11)
+        params = rng.normal(size=(WORLD, 6)).astype(np.float32)
+        in_p = rng.normal(size=(WORLD, 6)).astype(np.float32)
+        # a mid-flight state: half the weight mass rides the FIFO
+        ps_w = np.full((WORLD,), 0.5, np.float32)
+        in_w = np.full((WORLD,), 0.5, np.float32)
+        fifo = ((in_p, in_w),
+                (np.zeros_like(in_p), np.zeros_like(in_w)))
+        new_p, new_w, new_fl = jax.block_until_ready(
+            fn(params, ps_w, fifo))
+        want = (params.astype(np.float64).sum(0)
+                + in_p.astype(np.float64).sum(0)) / WORLD
+        np.testing.assert_allclose(np.asarray(new_p),
+                                   np.broadcast_to(want, (WORLD, 6)),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(new_w), 1.0, rtol=1e-6)
+        for slot_p, slot_w in new_fl:
+            np.testing.assert_allclose(np.asarray(slot_p), 0.0)
+            np.testing.assert_allclose(np.asarray(slot_w), 0.0)
 
 
 # -- chaos selftest (the CI gate, run in-process) ----------------------------
@@ -540,9 +567,10 @@ class TestCLIWiring:
         with pytest.raises(SystemExit, match="push-sum"):
             parse_config(["--inject_faults", "drop:0->1@0:4",
                           "--push_sum", "False"])
-        with pytest.raises(SystemExit, match="synchronous"):
-            parse_config(["--inject_faults", "drop:0->1@0:4",
-                          "--overlap", "True"])
+        # overlap + faults is a supported composition (launch-tick masks)
+        cfg, _ = parse_config(["--inject_faults", "drop:0->1@0:4",
+                               "--overlap", "True"])
+        assert cfg.overlap and cfg.inject_faults == "drop:0->1@0:4"
         with pytest.raises(ValueError, match="unknown fault kind"):
             parse_config(["--inject_faults", "warp:0@0:4"])
 

@@ -162,6 +162,12 @@ def _world_state(n=WORLD, seed=0):
     }
 
 
+def _map_like(tree, fn):
+    """Leaf-map over a nested-dict params tree."""
+    return {k: _map_like(v, fn) if isinstance(v, dict) else fn(v)
+            for k, v in tree.items()}
+
+
 def _oracle_mean(state):
     """Independent numpy oracle: per-leaf Σ rank rows / Σ ps_weight."""
     w = np.asarray(state["gossip"]["ps_weight"], np.float64).sum()
@@ -215,10 +221,56 @@ class TestReshardState:
         for k in before:
             np.testing.assert_allclose(after[k], before[k], atol=1e-9)
 
-    def test_overlap_in_flight_rejected(self):
+    def test_overlap_in_flight_folded_into_consensus(self):
+        """A formerly-overlap checkpoint (undrained FIFO) reshards: each
+        pending share is network mass counted exactly once in Σx/Σw, and
+        the new world starts with zero slots.  Verified against an
+        independent numpy oracle over the folded state."""
+        state = _world_state()
+        rng = np.random.default_rng(3)
+        slot_p = {
+            name: {leaf: rng.normal(size=arr.shape).astype(np.float32)
+                   for leaf, arr in sub.items()}
+            for name, sub in state["params"].items()}
+        slot_w = rng.uniform(0.1, 0.5, size=WORLD).astype(np.float32)
+        zero_p = _map_like(slot_p, np.zeros_like)
+        state["gossip"]["in_flight"] = {
+            "0": {"0": slot_p, "1": slot_w},
+            "1": {"0": zero_p, "1": np.zeros(WORLD, np.float32)},
+        }
+        w_sum = (np.asarray(state["gossip"]["ps_weight"],
+                            np.float64).sum() + slot_w.sum())
+        new = reshard_state(state, WORLD, 4)
+        for name, sub in new["params"].items():
+            for leaf, arr in sub.items():
+                want = (np.asarray(state["params"][name][leaf],
+                                   np.float64).sum(0)
+                        + np.asarray(slot_p[name][leaf],
+                                     np.float64).sum(0)) / w_sum
+                np.testing.assert_allclose(
+                    np.asarray(arr, np.float64).sum(0) / 4.0, want,
+                    atol=1e-6, err_msg=f"{name}/{leaf}")
+        # the resharded FIFO is empty slots at the new world
+        for slot in new["gossip"]["in_flight"].values():
+            for sub in slot["0"].values():
+                for arr in sub.values():
+                    assert arr.shape[0] == 4
+                    np.testing.assert_array_equal(arr, 0.0)
+            np.testing.assert_array_equal(slot["1"], 0.0)
+        # consensus_mean folds identically (the drift check's oracle)
+        before = consensus_mean(state)
+        after = consensus_mean(new)
+        for k in before:
+            np.testing.assert_allclose(after[k], before[k], atol=1e-6)
+
+    def test_unrecognizable_in_flight_rejected(self):
+        # a FIFO that is not (params, ps_weight) slots cannot be drained
         state = _world_state()
         state["gossip"]["in_flight"] = {"params": np.zeros((WORLD, 2))}
-        with pytest.raises(ValueError, match="in-flight"):
+        with pytest.raises(ValueError, match="in_flight|in-flight"):
+            reshard_state(state, WORLD, 4)
+        state["gossip"]["in_flight"] = {"0": {"x": 1}}
+        with pytest.raises(ValueError, match="slot"):
             reshard_state(state, WORLD, 4)
 
     def test_bad_ps_weight_rejected(self):

@@ -213,8 +213,13 @@ def test_scanned_steps_equal_sequential_steps(mesh, data, make_alg,
                                    rtol=1e-5, atol=1e-6)
     assert int(np.asarray(state_b.step)[0]) == k
     if staleness:
-        # both FIFO slots present and the newest one is non-empty
+        # both FIFO slots present; between steps the newest real share
+        # sits at the head and the tail slot is the freed one (the next
+        # pre_step's launch target)
         assert len(state_b.gossip.in_flight) == staleness
         newest = np.asarray(
-            jax.tree.leaves(state_b.gossip.in_flight[-1][0])[0])
+            jax.tree.leaves(state_b.gossip.in_flight[0][0])[0])
         assert np.abs(newest).max() > 0
+        tail = np.asarray(
+            jax.tree.leaves(state_b.gossip.in_flight[-1][0])[0])
+        assert np.abs(tail).max() == 0

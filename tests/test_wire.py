@@ -314,16 +314,19 @@ def test_hierarchical_delegate_lane_compression(mesh):
     assert np.abs(z - z.mean(0)).max() < 5e-2  # two-level mixing works
 
 
-def test_ef_requires_lossy_codec_and_sync_mode():
+def test_ef_requires_lossy_codec():
     sched = build_schedule(
         NPeerDynamicDirectedExponentialGraph(WORLD, peers_per_itr=1))
     with pytest.raises(ValueError, match="lossy wire codec"):
         sgp(sched, GOSSIP_AXIS, error_feedback=True)
     with pytest.raises(ValueError, match="lossy wire codec"):
         sgp(sched, GOSSIP_AXIS, wire=wire.F32, error_feedback=True)
-    with pytest.raises(ValueError, match="synchronous-mode"):
-        sgp(sched, GOSSIP_AXIS, overlap=True, wire=wire.Int8Codec(),
-            error_feedback=True)
+    # EF composes with overlap now: the residual telescopes against the
+    # round being SENT at launch (tests/test_overlap.py pins the
+    # telescoping identity on the compiled mesh)
+    alg = sgp(sched, GOSSIP_AXIS, overlap=True, wire=wire.Int8Codec(),
+              error_feedback=True)
+    assert alg.overlap and alg.error_feedback
     with pytest.raises(ValueError, match="not both"):
         sgp(sched, GOSSIP_AXIS, wire=wire.BF16,
             comm_dtype=jnp.bfloat16)
@@ -521,10 +524,11 @@ def test_sgd_cli_rejects_wire_knobs_outside_push_sum():
     with pytest.raises(SystemExit, match="lossy --wire_dtype"):
         parse_config(["--dataset", "synthetic",
                       "--error_feedback", "True"])
-    with pytest.raises(SystemExit, match="synchronous-mode"):
-        parse_config(["--dataset", "synthetic", "--overlap", "True",
-                      "--wire_dtype", "int8",
-                      "--error_feedback", "True"])
+    # overlap + lossy wire + EF is a supported composition now
+    cfg, _ = parse_config(["--dataset", "synthetic", "--overlap", "True",
+                           "--wire_dtype", "int8",
+                           "--error_feedback", "True"])
+    assert cfg.overlap and cfg.error_feedback and cfg.wire_dtype == "int8"
 
 
 def test_lm_cli_rejects_wire_knobs_outside_push_sum(tmp_path):
