@@ -62,6 +62,18 @@ BENCH_GVA_WIRE="f32,int8" plus BENCH_GVA_WIRE_BLOCK / BENCH_GVA_EF)
 adds a wire-codec sweep: the same gossip step timed per codec with the
 modeled ENCODED bytes (int8 scale overhead included) alongside — the
 calibration artifact for the planner's wire-fraction pricing.
+
+Third mode — ``python bench.py --synth-vs-registry``: model-only
+artifact for the planner's schedule *synthesizer* (planner/
+synthesize.py).  Runs the seeded beam search at world 12 and 48 on the
+16:1 DCN-dominant fabric plus a uniform-fabric control, and stamps the
+winning schedule's spectral gap and modeled priced bytes per consensus
+e-fold next to the best registry candidate's, with per-round ICI/DCN
+byte lanes for a reference payload (default ResNet-50 f32).  No
+measurement: the priced cost model IS the artifact, and fitting it to
+real step time is the on-chip calibration item in ROADMAP.  With
+``--selftest``, gates that synthesis beats the registry on both DCN
+cases (CI; knobs BENCH_SYNTH_BUDGET/PAYLOAD/OUT).
 """
 
 import json
@@ -801,6 +813,116 @@ def overlap_vs_sync_main(selftest: bool) -> int:
     return 0
 
 
+def synth_vs_registry_main(selftest: bool) -> int:
+    """--synth-vs-registry: stamp the synthesized schedule's modeled
+    priced bytes and gap next to the best registry candidate's (see the
+    module docstring).  Pure host math — no mesh, no child process."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import functools
+
+    from stochastic_gradient_push_tpu.planner import (
+        InterconnectModel,
+        SynthesisConfig,
+        evaluate_candidate,
+        plan_synthesized,
+        score_candidates,
+    )
+    from stochastic_gradient_push_tpu.telemetry import CommModel
+    from stochastic_gradient_push_tpu.topology import (
+        SynthesizedGraph,
+        build_schedule,
+        spec_fingerprint,
+    )
+
+    budget = int(os.environ.get("BENCH_SYNTH_BUDGET", "800"))
+    # reference payload: ResNet-50 f32 (~25.6M params × 4 B)
+    payload = int(os.environ.get("BENCH_SYNTH_PAYLOAD",
+                                 str(25_600_000 * 4)))
+    cfg = SynthesisConfig(budget=budget)
+
+    def round_bytes(schedule, fabric):
+        m = CommModel.from_schedule(schedule, payload,
+                                    interconnect=fabric)
+        phases = max(1, m.num_phases)
+        return {"wire": sum(m.wire_bytes_per_phase) // phases,
+                "ici": sum(m.ici_bytes_per_phase) // phases,
+                "dcn": sum(m.dcn_bytes_per_phase) // phases}
+
+    cases = []
+    for world, s, dcn in ((12, 4, 16.0), (48, 8, 16.0),
+                          (12, None, None)):
+        fabric = (InterconnectModel(slice_size=s, dcn_cost=dcn)
+                  if s else None)
+        regs = score_candidates(world, interconnect=fabric)
+        best_reg = regs[0]
+        reg_sched = build_schedule(
+            best_reg.graph_class(world, peers_per_itr=best_reg.ppi))
+        plan = plan_synthesized(world, interconnect=fabric, config=cfg)
+        row = {"world": world,
+               "fabric": fabric.to_dict() if fabric else None,
+               "plan_topology": plan.topology,
+               "beats_registry": plan.topology == "synth",
+               "registry_best": {
+                   **best_reg.to_dict(),
+                   "modeled_bytes_per_round": round_bytes(reg_sched,
+                                                          fabric)}}
+        if plan.topology == "synth":
+            spec = plan.synth["spec"]
+            ssched = build_schedule(SynthesizedGraph(world, spec=spec))
+            scand = evaluate_candidate(
+                functools.partial(SynthesizedGraph, spec=spec), world, 1,
+                interconnect=fabric)
+            row["synthesized"] = {
+                **scand.to_dict(),
+                "phases": [ph["kind"] for ph in spec["phases"]],
+                "fingerprint": spec_fingerprint(spec),
+                "evals": plan.synth["evals"],
+                "modeled_bytes_per_round": round_bytes(ssched, fabric)}
+        cases.append(row)
+
+    out = {"benchmark": "synth_vs_registry", "budget": budget,
+           "payload_bytes": payload, "seed": cfg.seed, "cases": cases}
+    out_path = os.environ.get(
+        "BENCH_SYNTH_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "artifacts", "bench_synth_vs_registry.json"))
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    out["artifact"] = out_path
+    print(json.dumps(out), flush=True)
+    if not selftest:
+        return 0
+    failures = []
+    for row in out["cases"]:
+        dcn_case = bool(row["fabric"])
+        if dcn_case and not row["beats_registry"]:
+            failures.append(
+                f"world {row['world']} on the DCN-dominant fabric: "
+                "synthesis did not beat the registry")
+        if row["beats_registry"] and not (
+                row["synthesized"]["priced_cost"]
+                < row["registry_best"]["priced_cost"]):
+            failures.append(
+                f"world {row['world']}: synthesized priced cost is not "
+                "below the registry best it claims to beat")
+    if failures:
+        for msg in failures:
+            print(f"synth-vs-registry selftest: FAIL — {msg}",
+                  file=sys.stderr)
+        return 1
+    beats = [f"world {r['world']}"
+             + ("" if not r["fabric"] else " (dcn)")
+             + (": synth "
+                f"{r['synthesized']['priced_cost']}"
+                if r["beats_registry"] else ": registry kept")
+             + f" vs registry {r['registry_best']['priced_cost']}"
+             for r in out["cases"]]
+    print("synth-vs-registry selftest: OK (" + "; ".join(beats) + ")",
+          flush=True)
+    return 0
+
+
 def _gva_flag_arg(argv: list[str], flag: str) -> str | None:
     """``FLAG NAME`` / ``FLAG=NAME`` from a raw argv (no argparse in the
     parent — it must stay transparent to child flags).  Raises
@@ -1153,5 +1275,7 @@ if __name__ == "__main__":
         print(json.dumps(run_overlap_vs_sync()), flush=True)
     elif "--overlap-vs-sync" in sys.argv:
         sys.exit(overlap_vs_sync_main("--selftest" in sys.argv))
+    elif "--synth-vs-registry" in sys.argv:
+        sys.exit(synth_vs_registry_main("--selftest" in sys.argv))
     else:
         main()

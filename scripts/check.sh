@@ -17,8 +17,12 @@ echo "== sgplint (AST lint + schedule verifier) =="
 python scripts/sgplint.py --check --report-json artifacts/gap_report.json
 
 echo
-echo "== planner self-check =="
+echo "== planner self-check (incl. schedule-synthesizer pins) =="
 python scripts/plan.py --world 8 --selftest
+
+echo
+echo "== synth-vs-registry artifact (synthesized schedule vs registry) =="
+python bench.py --synth-vs-registry --selftest
 
 echo
 echo "== chaos self-check (resilience: faults -> monitor -> recovery) =="

@@ -187,6 +187,7 @@ class PushSumGossip(GossipAlgorithm):
         self.axis_name = axis_name
         self.overlap = overlap
         from ..topology.hierarchical import HierarchicalSchedule
+        from ..topology.synthesized import SynthesizedSchedule
 
         if isinstance(schedule, HierarchicalSchedule) and faults is not None:
             # two-level rounds compile to leader ppermute + grouped psum;
@@ -197,6 +198,22 @@ class PushSumGossip(GossipAlgorithm):
                 "inject_faults is not supported on hierarchical "
                 "schedules: the intra-slice psum has no per-edge "
                 "mask (use a flat topology for fault drills)")
+        if isinstance(schedule, SynthesizedSchedule):
+            # same psum fence as hierarchical, plus overlap: a searched
+            # psum/ppermute composition has no augmented in-flight table
+            # form for the double-buffered round to verify against
+            if faults is not None:
+                raise ValueError(
+                    "inject_faults is not supported on synthesized "
+                    "schedules: grouped psum phases have no per-edge "
+                    "mask (use a flat registry topology for fault "
+                    "drills)")
+            if overlap:
+                raise ValueError(
+                    "overlap is not supported on synthesized "
+                    "schedules: a psum/ppermute phase composition has "
+                    "no single augmented in-flight form (use a "
+                    "registry topology for overlap runs)")
         # deterministic fault injection (resilience/faults.py FaultMasks):
         # the mixing boundary applies the plan's keep/corrupt masks with
         # mass-conserving reabsorption.  Composes with overlap — masks

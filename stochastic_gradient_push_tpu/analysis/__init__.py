@@ -31,6 +31,7 @@ from .verifier import (
     spectral_gap,
     spectral_gap_cache_clear,
     spectral_gap_cache_info,
+    spectral_gap_cache_limit,
     schedule_fingerprint,
     GapEntry,
     is_unsupported_config,
@@ -59,6 +60,7 @@ __all__ = [
     "schedule_fingerprint",
     "spectral_gap_cache_clear",
     "spectral_gap_cache_info",
+    "spectral_gap_cache_limit",
     "GapEntry",
     "is_unsupported_config",
     "DEFAULT_WORLD_SIZES",

@@ -32,6 +32,7 @@ All checks run on CPU in seconds: tables are numpy, never traced.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import inspect
 
@@ -42,7 +43,8 @@ from .findings import Finding
 __all__ = ["verify_schedule", "verify_pairing", "verify_topology",
            "verify_module", "verify_package", "DEFAULT_WORLD_SIZES",
            "GapEntry", "is_unsupported_config", "schedule_fingerprint",
-           "spectral_gap_cache_clear", "spectral_gap_cache_info"]
+           "spectral_gap_cache_clear", "spectral_gap_cache_info",
+           "spectral_gap_cache_limit"]
 
 # 2..64 per the convergence-grid contract: powers of two (pod slices),
 # odd/even non-powers (the shapes that break naive schedules)
@@ -123,31 +125,55 @@ def schedule_fingerprint(schedule) -> bytes:
 # scoring rebuild identical schedules many times per process (sgplint's
 # sweep alone visits hundreds of configurations; every plan_for call
 # rescans the candidate grid).  The eigenvalue solve dominates, so cache
-# gap by table fingerprint.  Entries are one float per digest — unbounded
-# growth is not a concern at any realistic schedule count.
-_GAP_CACHE: dict[bytes, float] = {}
-_GAP_STATS = {"hits": 0, "misses": 0}
+# gap by table fingerprint.  The cache is an LRU bounded by
+# spectral_gap_cache_limit(): a schedule-synthesis search
+# (planner/synthesize.py) evaluates thousands of one-off candidate
+# tables per run, so an unbounded dict would grow with every search a
+# long-lived process performs while the hits that matter (the registry
+# grid, the current search's frontier) all fit comfortably in the
+# default bound.
+_GAP_CACHE: "collections.OrderedDict[bytes, float]" = \
+    collections.OrderedDict()
+_GAP_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+_GAP_CACHE_MAX = 4096
 
 
 def spectral_gap_cache_info() -> dict:
-    """{'hits', 'misses', 'size'} of the spectral-gap memo (testing /
-    diagnostics)."""
+    """{'hits', 'misses', 'evictions', 'size', 'max'} of the
+    spectral-gap memo (testing / diagnostics)."""
     return {"hits": _GAP_STATS["hits"], "misses": _GAP_STATS["misses"],
-            "size": len(_GAP_CACHE)}
+            "evictions": _GAP_STATS["evictions"],
+            "size": len(_GAP_CACHE), "max": _GAP_CACHE_MAX}
+
+
+def spectral_gap_cache_limit(max_entries: int | None = None) -> int:
+    """Get (and with an argument, set) the LRU bound.  Shrinking evicts
+    oldest entries immediately; the bound must stay >= 1."""
+    global _GAP_CACHE_MAX
+    if max_entries is not None:
+        if max_entries < 1:
+            raise ValueError("spectral-gap cache limit must be >= 1")
+        _GAP_CACHE_MAX = int(max_entries)
+        while len(_GAP_CACHE) > _GAP_CACHE_MAX:
+            _GAP_CACHE.popitem(last=False)
+            _GAP_STATS["evictions"] += 1
+    return _GAP_CACHE_MAX
 
 
 def spectral_gap_cache_clear() -> None:
     _GAP_CACHE.clear()
     _GAP_STATS["hits"] = _GAP_STATS["misses"] = 0
+    _GAP_STATS["evictions"] = 0
 
 
 def spectral_gap(schedule) -> float:
     """``1 - |λ₂|`` of the full rotation-cycle product (memoized by
-    :func:`schedule_fingerprint`)."""
+    :func:`schedule_fingerprint` in a bounded LRU)."""
     fp = schedule_fingerprint(schedule)
     cached = _GAP_CACHE.get(fp)
     if cached is not None:
         _GAP_STATS["hits"] += 1
+        _GAP_CACHE.move_to_end(fp)
         return cached
     _GAP_STATS["misses"] += 1
     n = schedule.world_size
@@ -157,6 +183,9 @@ def spectral_gap(schedule) -> float:
     lam = np.sort(np.abs(np.linalg.eigvals(prod)))[::-1]
     gap = float(1.0 - (lam[1] if n > 1 else 0.0))
     _GAP_CACHE[fp] = gap
+    while len(_GAP_CACHE) > _GAP_CACHE_MAX:
+        _GAP_CACHE.popitem(last=False)
+        _GAP_STATS["evictions"] += 1
     return gap
 
 

@@ -58,6 +58,7 @@ from jax import lax
 
 from ..topology.hierarchical import HierarchicalSchedule
 from ..topology.schedule import GossipSchedule
+from ..topology.synthesized import SynthesizedSchedule
 from . import wire as wire_mod
 
 __all__ = [
@@ -279,6 +280,31 @@ def intra_average(tree, hsched: HierarchicalSchedule, axis_name: str):
                            axis_index_groups=groups), tree)
 
 
+def _synth_round_fn(ssched: SynthesizedSchedule, phase_idx: int,
+                    axis_name: str, comm_dtype=None, codec=None):
+    """One compiled synthesized phase: an edge phase is one ``ppermute``
+    round through the compact per-phase tables (full wire-codec path),
+    a psum phase is ONE grouped ``lax.psum`` over the spec's equal rank
+    blocks — numerically exactly the ``g − 1`` rotate-permutation
+    matrix the verifier checks.  The error-feedback residual rides edge
+    phases only and passes through psum phases untouched (an exact
+    collective has no quantization error to account)."""
+    if ssched.phase_kinds[phase_idx] == "psum":
+        groups = [list(g) for g in ssched.phase_groups[phase_idx]]
+        inv_g = 1.0 / len(groups[0])
+
+        def mix(tree, tick, residual):
+            out = jax.tree.map(
+                lambda a: lax.psum(a * jnp.asarray(inv_g, a.dtype),
+                                   axis_name, axis_index_groups=groups),
+                tree)
+            return out, residual
+
+        return mix
+    return _round_fn(ssched.edge_phase_schedule(phase_idx), 0, axis_name,
+                     comm_dtype, codec=codec)
+
+
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
                  comm_dtype=None, faults=None, tick=None, codec=None,
                  ef_residual=None):
@@ -295,7 +321,12 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
     its two-level form: leader ``ppermute`` across slices plus one grouped
     ``psum`` inside each slice per round (see :func:`_hier_round_fn`);
     ``phase`` then counts *rounds*, each spanning two table phases, and
-    the codec compresses the delegate (DCN) lane only.
+    the codec compresses the delegate (DCN) lane only.  A
+    :class:`~..topology.synthesized.SynthesizedSchedule` compiles one
+    round per table phase — an edge phase is one ``ppermute``, a psum
+    phase one grouped collective (see :func:`_synth_round_fn`); the
+    codec compresses edge phases only, and fault injection / overlap
+    are rejected (no per-edge psum mask, no augmented table form).
 
     ``faults`` applies a compiled fault plan (resilience/faults.py) with
     mass-conserving drop semantics; ``tick`` is the fault-time index (a
@@ -367,6 +398,18 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
             "fault injection is not supported on hierarchical "
             "schedules: the intra-slice psum has no per-edge mask "
             "(use a flat topology for fault drills)")
+    if isinstance(schedule, SynthesizedSchedule):
+        if faults is not None:
+            raise ValueError(
+                "fault injection is not supported on synthesized "
+                "schedules: grouped psum phases have no per-edge mask "
+                "(use a flat registry topology for fault drills)")
+        if split:
+            raise ValueError(
+                "overlap is not supported on synthesized schedules: a "
+                "psum/ppermute phase composition has no single "
+                "augmented in-flight form (use a registry topology for "
+                "overlap runs)")
     if ef_residual is not None and _resolve_codec(codec, comm_dtype) is None:
         raise ValueError(
             "error feedback needs a lossy wire codec (bf16/int8); exact "
@@ -381,7 +424,16 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
             return (tree, jax.tree.map(jnp.zeros_like, tree)), ef_residual
         return tree, ef_residual
 
-    if isinstance(schedule, HierarchicalSchedule):
+    if isinstance(schedule, SynthesizedSchedule):
+        # one compiled round per table phase (edge ppermute or grouped
+        # psum); the traced phase index selects among them like any
+        # flat rotation
+        branches = [_synth_round_fn(schedule, p, axis_name, comm_dtype,
+                                    codec)
+                    for p in range(schedule.num_phases)]
+        idx = as_scalar(phase) % schedule.num_phases
+        fault_tick = None
+    elif isinstance(schedule, HierarchicalSchedule):
         rounds = schedule.rounds_per_cycle
         if split:
             # overlap launch: the delegate ppermute only — the caller

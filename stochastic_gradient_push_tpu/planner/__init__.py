@@ -21,6 +21,11 @@ in milliseconds.  This package makes it *actionable* at launch:
   ``check_topology`` scores user-forced choices and attaches loud
   structured warnings; ``resolve_topology`` is the run layer's single
   entry point (``--topology auto``);
+* :mod:`.synthesize` — the schedule *synthesizer* (``--topology
+  synth``): a seeded deterministic beam search over compositions of
+  ppermute edge phases and grouped exact-psum phases, maximizing
+  spectral gap per priced byte on the fabric; falls back to the
+  registry plan whenever the search does not strictly beat it;
 * :mod:`.cli` — ``scripts/plan.py``: ranked tables for offline capacity
   planning plus the CI self-check.
 
@@ -51,6 +56,12 @@ from .scorer import (
     evaluate_candidate,
     score_candidates,
 )
+from .synthesize import (
+    SynthesisConfig,
+    SynthesisResult,
+    plan_synthesized,
+    synthesize,
+)
 
 __all__ = [
     "DEFAULT_DCN_COST",
@@ -61,6 +72,8 @@ __all__ = [
     "InterconnectModel",
     "Plan",
     "PlanConstraints",
+    "SynthesisConfig",
+    "SynthesisResult",
     "alpha_gap",
     "check_topology",
     "consensus_cost",
@@ -69,6 +82,8 @@ __all__ = [
     "make_interconnect",
     "optimize_alpha",
     "plan_for",
+    "plan_synthesized",
     "resolve_topology",
     "score_candidates",
+    "synthesize",
 ]

@@ -6,9 +6,17 @@ Modes:
   period, rationale) for ``--world``/``--ppi``;
 * ``--topology NAME`` — score a forced topology instead of planning,
   surfacing the below-floor warning exactly as the run layer would;
-* ``--report`` — print the full ranked candidate table;
+* ``--synthesize`` (or ``--topology synth``) — search a hybrid
+  psum/ppermute schedule against the priced fabric and report it next
+  to the registry ranking (falls back to the registry plan when the
+  search does not strictly beat it);
+* ``--report`` — print the full ranked candidate table (plus the
+  synthesized row under ``--synthesize``);
 * ``--json PATH`` — also dump the plan as JSON (``-`` = stdout);
-* ``--selftest`` — cheap invariant checks for CI (scripts/check.sh).
+* ``--selftest`` — cheap invariant checks for CI (scripts/check.sh),
+  including the synthesis pins: beats every registry entry at world 12
+  and 48 on a 16:1 DCN-dominant fabric, reproducible at equal seed, and
+  never loses to the registry winner on a uniform fabric.
 """
 
 from __future__ import annotations
@@ -24,7 +32,11 @@ from .policy import (
     check_topology,
     plan_for,
 )
-from .scorer import DEFAULT_PEER_COUNTS, score_candidates
+from .scorer import (
+    DEFAULT_PEER_COUNTS,
+    evaluate_candidate,
+    score_candidates,
+)
 
 
 def _fmt(v: float, width: int = 10) -> str:
@@ -116,6 +128,59 @@ def _selftest(world: int, floor: float) -> int:
           "hierarchical candidate does not minimize DCN volume per "
           "consensus e-fold among floor-clearing candidates")
 
+    # schedule synthesizer: on a 16:1 DCN-dominant fabric the searched
+    # hybrid psum/ppermute cycle must beat EVERY registry entry on
+    # priced cost per consensus e-fold — at a non-power-of-two world
+    # (12, where the registry is known-degraded) and a pod world (48) —
+    # verify through SGPV like any schedule, and reproduce run-to-run
+    # (the search is seeded + deterministic); on a uniform fabric it
+    # must never lose to the registry winner (falling back if unbeaten)
+    from functools import partial
+
+    from ..topology.synthesized import SynthesizedGraph
+    from .synthesize import SynthesisConfig, plan_synthesized, synthesize
+
+    scfg = SynthesisConfig(budget=800)
+    for w, s in ((12, 4), (48, 8)):
+        sfab = InterconnectModel(slice_size=s, dcn_cost=16.0)
+        splan = plan_synthesized(w, interconnect=sfab, config=scfg,
+                                 floor=floor)
+        check(splan.topology == "synth",
+              f"synthesis did not beat the registry at world {w} on the "
+              f"16:1 fabric (got {splan.summary()})")
+        if splan.topology != "synth":
+            continue
+        regs = score_candidates(w, interconnect=sfab)
+        scand = evaluate_candidate(
+            partial(SynthesizedGraph, spec=splan.synth["spec"]), w, 1,
+            interconnect=sfab)
+        check(scand.gap >= floor
+              and all(scand.priced_cost < c.priced_cost for c in regs),
+              f"synthesized world-{w} schedule does not beat every "
+              "registry entry on priced cost per consensus e-fold")
+        sfind, sgap = verify_schedule(
+            build_schedule(SynthesizedGraph(w, spec=splan.synth["spec"])),
+            f"synth-{w}", "<selftest>", 0)
+        check(sfind == [] and sgap > floor,
+              f"synthesized world-{w} schedule failed verification: "
+              f"{[f.rule for f in sfind]} gap={sgap}")
+    sfab12 = InterconnectModel(slice_size=4, dcn_cost=16.0)
+    r1 = synthesize(12, interconnect=sfab12, config=scfg)
+    r2 = synthesize(12, interconnect=sfab12, config=scfg)
+    check(r1 is not None and r2 is not None and r1.spec == r2.spec,
+          "synthesis is not reproducible run-to-run at equal "
+          "seed/budget")
+    uplan = plan_synthesized(world, config=scfg, floor=floor)
+    ucands = score_candidates(world, floor=floor)
+    ubar = min(c.priced_cost for c in ucands if c.meets(floor))
+    if uplan.topology == "synth":
+        check(uplan.synth["priced_cost"] < ubar,
+              "uniform-fabric synthesis won the plan without beating "
+              "the registry winner")
+    else:
+        check(uplan.topology == plan_for(world, ppi=None).topology,
+              "uniform-fabric fallback did not keep the registry plan")
+
     if failures:
         for f in failures:
             print(f"planner selftest FAILED: {f}", file=sys.stderr)
@@ -156,6 +221,20 @@ def main(argv=None) -> int:
     ap.add_argument("--self-weighted", action="store_true",
                     help="co-optimize a SelfWeightedMixing alpha against "
                          "the chosen topology")
+    ap.add_argument("--synthesize", action="store_true",
+                    help="search a hybrid psum/ppermute schedule against "
+                         "the priced fabric (planner/synthesize.py) and "
+                         "plan it when it strictly beats the registry "
+                         "(equivalent to --topology synth)")
+    ap.add_argument("--synth-seed", type=int, default=0,
+                    help="synthesizer seed (random-permutation moves; "
+                         "the search is otherwise deterministic)")
+    ap.add_argument("--synth-budget", type=int, default=None,
+                    help="max candidate evaluations (default 1200)")
+    ap.add_argument("--synth-beam", type=int, default=None,
+                    help="beam width (default 6)")
+    ap.add_argument("--synth-phases", type=int, default=None,
+                    help="longest synthesized cycle (default 6)")
     ap.add_argument("--report", action="store_true",
                     help="print the full ranked candidate table")
     ap.add_argument("--json", default=None, metavar="PATH",
@@ -170,10 +249,23 @@ def main(argv=None) -> int:
         return _selftest(args.world, args.floor)
 
     ppi = args.ppi if args.ppi else None
+    synthesize_mode = args.synthesize or args.topology == "synth"
     try:
         interconnect = make_interconnect(args.slice_size, args.dcn_cost,
                                          args.ici_cost)
-        if args.topology:
+        if synthesize_mode:
+            from .synthesize import SynthesisConfig, plan_synthesized
+
+            plan = plan_synthesized(
+                args.world, ppi=ppi, algorithm=args.algorithm,
+                floor=args.floor, interconnect=interconnect,
+                self_weighted=args.self_weighted,
+                config=SynthesisConfig.from_dict({
+                    "seed": args.synth_seed,
+                    "budget": args.synth_budget,
+                    "beam_width": args.synth_beam,
+                    "max_phases": args.synth_phases}))
+        elif args.topology:
             from ..topology import TOPOLOGY_NAMES
             if args.topology not in TOPOLOGY_NAMES:
                 ap.error(f"unknown topology {args.topology!r}; one of "
@@ -204,7 +296,18 @@ def main(argv=None) -> int:
         cands = score_candidates(
             args.world, (ppi,) if ppi else DEFAULT_PEER_COUNTS,
             floor=args.floor, interconnect=interconnect)
-        _print_table(cands, args.floor, priced=interconnect is not None)
+        if synthesize_mode and plan.topology == "synth":
+            # the synthesized winner as a ranked row next to the
+            # registry's, built by the same evaluate_candidate path
+            from functools import partial
+
+            from ..topology.synthesized import SynthesizedGraph
+
+            cands = [evaluate_candidate(
+                partial(SynthesizedGraph, spec=plan.synth["spec"]),
+                args.world, ppi or 1, interconnect=interconnect)] + cands
+        _print_table(cands, args.floor,
+                     priced=interconnect is not None or synthesize_mode)
     if args.json:
         payload = json.dumps(plan.to_dict(), indent=2, sort_keys=True)
         if args.json == "-":

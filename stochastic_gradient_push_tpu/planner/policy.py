@@ -95,6 +95,13 @@ class PlanConstraints:
     # fraction (hierarchical intra-slice exact averages stay full
     # precision), and the config is stamped into the plan
     wire: dict | None = None
+    # schedule synthesis request (planner/synthesize.py): a knob dict
+    # ({"seed", "budget", "beam_width", "max_phases", and optionally a
+    # stamped "spec" to reuse}).  Non-None routes plan_for through the
+    # synthesizer, which falls back to the registry plan whenever the
+    # search does not strictly beat it — the supervisor's replan path
+    # and the recovery policy thread a synthesized run's stamp here.
+    synth: dict | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,10 +133,21 @@ class Plan:
     # "error_feedback"}; None = exact f32) — comm_cost above is priced at
     # this encoding, and the stamp rides into checkpoint metadata
     wire: dict | None = None
+    # synthesized-schedule stamp (topology == "synth"): the search knobs
+    # plus the winning spec, JSON-safe — checkpoint meta carries it, so
+    # resume/replan rebuild the exact searched schedule
+    synth: dict | None = None
 
     @property
     def graph_class(self):
         cls = TOPOLOGY_NAMES[self.topology]
+        if self.synth is not None and self.synth.get("spec"):
+            from ..topology.synthesized import SynthesizedGraph
+
+            # bind the stamped spec so graph_class(world, peers_per_itr=
+            # ppi) rebuilds exactly the searched, verified, priced tables
+            return functools.partial(SynthesizedGraph,
+                                     spec=self.synth["spec"])
         if self.slice_size and isinstance(cls, type) \
                 and issubclass(cls, HierarchicalGraph):
             # the run layer instantiates graph_class(world, peers_per_itr=
@@ -244,6 +262,16 @@ def plan_for(world: int, ppi: int | None = None, algorithm: str = "sgp",
     """
     cons = constraints or PlanConstraints()
     _check_algorithm(algorithm, cons.self_weighted)
+    if cons.synth is not None and world >= 2:
+        from .synthesize import SynthesisConfig, plan_synthesized
+
+        return plan_synthesized(
+            world, ppi=ppi, algorithm=algorithm, floor=cons.floor,
+            interconnect=cons.interconnect, wire=cons.wire,
+            global_avg_every=global_avg_every, overlap=cons.overlap,
+            faults=cons.faults, self_weighted=cons.self_weighted,
+            config=SynthesisConfig.from_dict(cons.synth),
+            stamped_spec=cons.synth.get("spec"))
     if world < 2:
         return Plan(world=world, ppi=ppi or 1,
                     topology="npeer-exponential", mixing="uniform",
@@ -449,13 +477,15 @@ def resolve_topology(world: int, *, ppi: int = 1,
                      interconnect: InterconnectModel | None = None,
                      overlap: bool = False, faults: bool = False,
                      wire: dict | None = None,
+                     synth: dict | None = None,
                      log=None, registry=None) -> Plan:
     """Run-layer entry point: resolve ``--topology``/``--graph_type`` into
     a :class:`Plan`, log it, and emit any warnings.
 
     Args:
-      topology: "auto" (plan), a registered name (forced), or None
-        (forced via ``graph_class``).
+      topology: "auto" (plan), "synth" (search a schedule against the
+        priced fabric, falling back to the registry when not beaten), a
+        registered name (forced), or None (forced via ``graph_class``).
       graph_class: the topology class selected by legacy flags; used when
         ``topology`` is None.
       global_avg_every: user override for the averaging period (None =
@@ -474,13 +504,28 @@ def resolve_topology(world: int, *, ppi: int = 1,
         --error_feedback ({"dtype", "block", "error_feedback"}); gossip
         lanes are priced at the encoded fraction and the config is
         stamped into the plan (and from there into checkpoint meta).
+      synth: search-budget knobs for --topology synth (the --synth_*
+        flags; {"seed", "budget", "beam_width", "max_phases"}, plus an
+        optional stamped "spec" to reuse).  Only meaningful with
+        topology == "synth".
       log: optional logger; the plan is logged as one JSON line and each
         warning loudly via ``log.warning``.
       registry: optional telemetry registry; when set, the plan publishes
         as a typed ``plan`` event (the registry's compat sink renders the
         legacy ``gossip plan:`` line) and ``log`` carries only warnings.
     """
-    if topology == "auto":
+    if topology == "synth":
+        from .synthesize import SynthesisConfig, plan_synthesized
+
+        synth = synth or {}
+        plan = plan_synthesized(
+            world, ppi=ppi, algorithm=algorithm, floor=floor,
+            interconnect=interconnect, wire=wire,
+            global_avg_every=global_avg_every, overlap=overlap,
+            faults=faults, self_weighted=self_weighted,
+            config=SynthesisConfig.from_dict(synth),
+            stamped_spec=synth.get("spec"))
+    elif topology == "auto":
         plan = plan_for(world, ppi=ppi, algorithm=algorithm,
                         constraints=PlanConstraints(
                             floor=floor, self_weighted=self_weighted,

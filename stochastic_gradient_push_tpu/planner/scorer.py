@@ -50,6 +50,8 @@ import dataclasses
 import functools
 import math
 
+import numpy as np
+
 # shared with the verifier (stable exports) so the planner and the CI
 # gate measure gaps identically and skip the exact same cells
 from ..analysis import is_unsupported_config, spectral_gap
@@ -181,18 +183,40 @@ def wire_per_round(schedule, wire_fraction: float = 1.0) -> float:
     allreduce (``2·(s−1)/s`` payloads per rank, the bandwidth-optimal
     ring cost).
 
+    Synthesized schedules (``topology/synthesized.py``) average over the
+    cycle's phases: an edge phase ships one payload per *sending* rank
+    (sparse delegate-style permutations send far less than one payload
+    per rank), a psum phase the grouped ring-allreduce ``2·(g−1)/g``.
+
     ``wire_fraction`` is the encoded-bytes/full-precision ratio of the
     active wire codec (:meth:`~..parallel.wire.WireCodec.wire_fraction`
     — e.g. 0.266 for int8 at block 64).  It scales the *gossip* payload
-    lanes only: the hierarchical intra-slice exact average never
-    compresses, exactly as the collective layer compiles it.
+    lanes only: grouped exact averages (hierarchical intra, synthesized
+    psum phases) never compress, exactly as the collective layer
+    compiles them.
     """
-    if getattr(schedule, "phase_kinds", None) is None:
+    kinds = getattr(schedule, "phase_kinds", None)
+    if kinds is None:
         return float(schedule.peers_per_itr) * wire_fraction
-    s = schedule.slice_size
-    inter = (schedule.num_slices * schedule.dcn_fanout
-             * schedule.inter_ppi / schedule.world_size)
-    return inter * wire_fraction + 2.0 * (s - 1) / s
+    if "inter" in kinds:   # hierarchical two-level round
+        s = schedule.slice_size
+        inter = (schedule.num_slices * schedule.dcn_fanout
+                 * schedule.inter_ppi / schedule.world_size)
+        return inter * wire_fraction + 2.0 * (s - 1) / s
+    # synthesized composition: per-round mean over the cycle
+    n = schedule.world_size
+    total = 0.0
+    ident = np.arange(n)
+    for p, kind in enumerate(kinds):
+        if kind == "psum":
+            g = len(schedule.phase_groups[p][0])
+            total += 2.0 * (g - 1) / g
+        else:
+            senders = int(np.count_nonzero(
+                (np.asarray(schedule.edge_weights[p, 0]) > 0)
+                & (np.asarray(schedule.perms[p, 0]) != ident)))
+            total += senders / n * wire_fraction
+    return total / len(kinds)
 
 
 def cycle_cost(schedule, model: InterconnectModel,
@@ -211,23 +235,37 @@ def cycle_cost(schedule, model: InterconnectModel,
     graphs win the ranking on a uniform fabric and hierarchical wins
     only when the fabric says DCN dominates.
 
+    Synthesized psum phases follow the same rule with their own groups:
+    when the model declares slice structure and every group sits inside
+    one slice, the phase prices as grouped ring-allreduces
+    (``2·(g−1)/g`` payloads per member at one ICI hop); otherwise it is
+    priced as its rotate-permutation tables are written.
+
     ``wire_fraction`` scales every *gossip message* by the active wire
-    codec's encoded-bytes ratio; intra-slice exact averages (grouped
-    psum) stay full precision, as compiled.
+    codec's encoded-bytes ratio; grouped exact averages (hierarchical
+    intra, synthesized psum) stay full precision, as compiled.
     """
     n = schedule.world_size
     kinds = getattr(schedule, "phase_kinds", None)
     ici = dcn = 0.0
     for p in range(schedule.num_phases):
-        intra = kinds is not None and kinds[p] == "intra"
-        if intra and model.slice_size:
+        kind = kinds[p] if kinds is not None else None
+        if kind == "intra" and model.slice_size:
             s = schedule.slice_size
             ici += model.ici_cost * 2.0 * (s - 1) / s
             continue
-        # intra phases priced as written (no slice structure to fuse
-        # into) still ship EXACT payloads — the compiled grouped psum
-        # never compresses, whatever the gossip codec does
-        frac = 1.0 if intra else wire_fraction
+        if kind == "psum" and model.slice_size and all(
+                len({model.slice_of(r) for r in grp}) == 1
+                for grp in schedule.phase_groups[p]):
+            for grp in schedule.phase_groups[p]:
+                g = len(grp)
+                ici += model.ici_cost * 2.0 * (g - 1) / g * g / n
+            continue
+        # exact-average phases priced as written (no slice structure to
+        # fuse into, or a group spanning slices) still ship EXACT
+        # payloads — the compiled grouped psum never compresses,
+        # whatever the gossip codec does
+        frac = 1.0 if kind in ("intra", "psum") else wire_fraction
         perms = schedule.perms[p]
         weights = schedule.edge_weights[p]
         for i in range(schedule.peers_per_itr):

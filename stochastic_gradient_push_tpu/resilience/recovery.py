@@ -125,7 +125,8 @@ class RecoveryPolicy:
                  cooldown_steps: int = 10,
                  max_recoveries: int = 0, log=None, registry=None,
                  interconnect=None, faults: bool = False,
-                 wire: dict | None = None):
+                 wire: dict | None = None,
+                 synth: dict | None = None):
         self.world = world
         self.ppi = ppi
         self.algorithm = algorithm
@@ -141,6 +142,10 @@ class RecoveryPolicy:
         # the run's wire codec config: re-plan suggestions price gossip
         # lanes at the encoded fraction the relaunch would actually ship
         self.wire = wire
+        # a synthesized run's stamp (search knobs + winning spec): the
+        # re-plan re-enters the synthesizer — reusing the stamped spec
+        # as a seed candidate — instead of falling back to the registry
+        self.synth = synth
         self.residual_floor = residual_floor
         self.cooldown_steps = max(0, cooldown_steps)
         self.max_recoveries = max_recoveries
@@ -165,7 +170,8 @@ class RecoveryPolicy:
         plan = plan_for(self.world, ppi=self.ppi, algorithm=self.algorithm,
                         constraints=PlanConstraints(
                             interconnect=self.interconnect,
-                            faults=self.faults, wire=self.wire))
+                            faults=self.faults, wire=self.wire,
+                            synth=self.synth))
         return {"topology": plan.topology, "ppi": plan.ppi,
                 "gap": round(plan.gap, 6),
                 "global_avg_every": plan.global_avg_every,

@@ -24,10 +24,10 @@ import os
 import time
 
 from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
-from .gossip_sgd import (add_staleness_flag, add_wire_flags,
-                         reject_push_sum_wire_knobs,
+from .gossip_sgd import (add_staleness_flag, add_synth_flags,
+                         add_wire_flags, reject_push_sum_wire_knobs,
                          resolve_staleness_flag, resolve_wire_flags,
-                         wire_plan_config)
+                         synth_plan_config, wire_plan_config)
 
 __all__ = ["main", "build_parser"]
 
@@ -47,9 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topology", default=None,
                    choices=["auto"] + sorted(TOPOLOGY_NAMES),
                    help="named topology: 'auto' lets the planner pick "
-                        "the gossip graph for the replica count; a name "
-                        "forces it (overriding --graph_type) with a "
-                        "below-floor warning when its gap is too small")
+                        "the gossip graph for the replica count; "
+                        "'synth' searches a hybrid psum/ppermute "
+                        "schedule against the priced fabric (registry "
+                        "fallback when not beaten); a name forces it "
+                        "(overriding --graph_type) with a below-floor "
+                        "warning when its gap is too small")
+    add_synth_flags(p)
     p.add_argument("--gap_floor", default=0.01, type=float,
                    help="minimum acceptable rotation-cycle spectral gap "
                         "for the gossip graph (planner policy)")
@@ -376,6 +380,7 @@ def main(argv=None):
     # data-parallel replica count, not raw devices
     plan = None
     interconnect = None
+    synth = synth_plan_config(args)   # rejects stray --synth_* knobs
     if not sb(args.all_reduce) and not sb(args.bilat) and dp > 1:
         from ..planner import make_interconnect, resolve_topology
 
@@ -391,16 +396,17 @@ def main(argv=None):
             global_avg_every=args.global_avg_every,  # None = policy
             interconnect=interconnect,
             overlap=sb(args.overlap), faults=bool(args.inject_faults),
-            wire=wire_plan_config(args),
+            wire=wire_plan_config(args), synth=synth,
             log=log, registry=rt.registry)
     elif args.topology is not None and (sb(args.all_reduce)
                                         or sb(args.bilat)):
         raise SystemExit("--topology selects a push-sum/D-PSGD gossip "
                          "graph; it does not apply to all_reduce/bilat "
                          "modes")
-    elif args.topology == "auto":
-        raise SystemExit("--topology auto plans gossip schedules; it does "
-                         "not apply to a single-replica mesh")
+    elif args.topology in ("auto", "synth"):
+        raise SystemExit(f"--topology {args.topology} plans gossip "
+                         "schedules; it does not apply to a "
+                         "single-replica mesh")
     if pp > 1:
         from ..train.pp import (build_pp_train_step, init_pp_state,
                                 make_dp_pp_ep_mesh, make_dp_pp_ep_sp_mesh,
@@ -928,7 +934,8 @@ def main(argv=None):
                 cooldown_steps=args.health_every, log=log,
                 registry=rt.registry, interconnect=interconnect,
                 faults=bool(args.inject_faults),
-                wire=wire_plan_config(args))
+                wire=wire_plan_config(args),
+                synth=plan.synth if plan is not None else None)
             recovery = make_recovery_fn(alg, mesh)
 
     loss_meter = Meter(ptag="Loss")

@@ -90,6 +90,44 @@ def resolve_wire_flags(args) -> None:
     args.error_feedback = ef
 
 
+def add_synth_flags(p: argparse.ArgumentParser) -> None:
+    """Schedule-synthesizer budget knobs, shared by both run CLIs: only
+    meaningful with ``--topology synth`` (planner/synthesize.py)."""
+    p.add_argument("--synth_seed", default=None, type=int,
+                   help="schedule-synthesizer seed, default 0 (feeds "
+                        "the random-permutation moves; the search is "
+                        "otherwise deterministic, so seed+knobs "
+                        "reproduce the schedule exactly)")
+    p.add_argument("--synth_budget", default=None, type=int,
+                   help="max candidate-schedule evaluations in the "
+                        "synthesizer's beam search (default 1200)")
+    p.add_argument("--synth_beam", default=None, type=int,
+                   help="beam width: contracting phase-sequence "
+                        "prefixes kept per search depth (default 6)")
+    p.add_argument("--synth_phases", default=None, type=int,
+                   help="longest synthesized cycle considered, in "
+                        "phases (default 6)")
+
+
+def synth_plan_config(args) -> dict | None:
+    """The synthesizer knob dict for the planner (None when --topology
+    is not 'synth'); rejects stray --synth_* knobs on other topologies
+    instead of silently ignoring them."""
+    knobs_set = any(v is not None for v in (
+        args.synth_seed, args.synth_budget, args.synth_beam,
+        args.synth_phases))
+    if args.topology != "synth":
+        if knobs_set:
+            raise SystemExit(
+                "--synth_seed/--synth_budget/--synth_beam/"
+                "--synth_phases tune the schedule synthesizer; they "
+                "need --topology synth")
+        return None
+    return {"seed": args.synth_seed, "budget": args.synth_budget,
+            "beam_width": args.synth_beam,
+            "max_phases": args.synth_phases}
+
+
 def add_staleness_flag(p: argparse.ArgumentParser) -> None:
     """The overlap staleness bound, shared by both run CLIs (gossip_sgd
     and gossip_lm): the in-flight FIFO depth of the double-buffered
@@ -191,9 +229,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto"] + sorted(TOPOLOGY_NAMES),
                    help="named topology selection: 'auto' lets the "
                         "planner pick (and tune) the gossip graph for "
-                        "the world size; a name forces it (overriding "
+                        "the world size; 'synth' searches a hybrid "
+                        "psum/ppermute schedule against the priced "
+                        "fabric (falling back to the registry when not "
+                        "beaten); a name forces it (overriding "
                         "--graph_type) with a below-floor warning when "
                         "its spectral gap is too small")
+    add_synth_flags(p)
     p.add_argument("--gap_floor", default=0.01, type=float,
                    help="minimum acceptable rotation-cycle spectral gap; "
                         "below it the planner auto-switches (or warns "
@@ -494,14 +536,16 @@ def _resolve_plan(cfg, args, gossip_world: int, log, registry=None):
     fabric_flags = (args.slice_size is not None
                     or args.dcn_cost is not None
                     or args.ici_cost is not None)
+    synth = synth_plan_config(args)   # rejects stray --synth_* knobs
     if cfg.all_reduce or cfg.bilat or cfg.bilat_async or gossip_world < 2:
-        if args.topology == "auto" or args.mixing_alpha is not None \
-                or fabric_flags:
-            raise SystemExit("--topology auto / --mixing_alpha / fabric "
-                             "flags (--slice_size/--dcn_cost/--ici_cost) "
-                             "plan gossip schedules; they do not apply "
-                             "to all_reduce/bilateral modes or a "
-                             "single-rank world")
+        if args.topology in ("auto", "synth") \
+                or args.mixing_alpha is not None or fabric_flags \
+                or synth is not None:
+            raise SystemExit("--topology auto/synth / --mixing_alpha / "
+                             "fabric flags (--slice_size/--dcn_cost/"
+                             "--ici_cost) plan gossip schedules; they do "
+                             "not apply to all_reduce/bilateral modes or "
+                             "a single-rank world")
         return
     from ..planner import make_interconnect, resolve_topology
     from ..train.lr import ppi_at_epoch
@@ -523,7 +567,7 @@ def _resolve_plan(cfg, args, gossip_world: int, log, registry=None):
         global_avg_every=args.global_avg_every,  # None = policy decides
         interconnect=interconnect,
         overlap=cfg.overlap, faults=bool(cfg.inject_faults),
-        wire=wire_plan_config(args),
+        wire=wire_plan_config(args), synth=synth,
         log=log, registry=registry)
     cfg.graph_class = plan.graph_class
     if plan.alpha is not None:

@@ -149,15 +149,35 @@ class ChildSpec:
         if plan is not None:
             argv = _set_flag(argv, "--topology", plan["topology"])
             for name in ("--global_avg_every", "--slice_size",
-                         "--mixing_alpha"):
+                         "--mixing_alpha", "--synth_seed",
+                         "--synth_budget", "--synth_beam",
+                         "--synth_phases"):
                 argv = _strip_flag(argv, name)
             if plan.get("global_avg_every"):
                 argv += ["--global_avg_every",
                          str(plan["global_avg_every"])]
-            if plan.get("slice_size"):
-                argv += ["--slice_size", str(plan["slice_size"])]
+            # a plan's own slice_size is the hierarchical decomposition;
+            # flat/synthesized plans priced on a sliced fabric carry the
+            # slice only in the interconnect stamp — without it the
+            # child's surviving --dcn_cost would be rejected at launch
+            # (make_interconnect: dcn_cost needs slice structure)
+            slice_size = plan.get("slice_size") or (
+                (plan.get("interconnect") or {}).get("slice_size"))
+            if slice_size:
+                argv += ["--slice_size", str(slice_size)]
             if plan.get("alpha") is not None:
                 argv += ["--mixing_alpha", str(plan["alpha"])]
+            if plan["topology"] == "synth" and plan.get("synth"):
+                # relaunch with the stamp's search knobs: the child's
+                # deterministic re-search (same seed/budget/world)
+                # re-derives the stamped schedule, and the resumed
+                # checkpoint's own stamp seeds it regardless
+                for flag, key in (("--synth_seed", "seed"),
+                                  ("--synth_budget", "budget"),
+                                  ("--synth_beam", "beam_width"),
+                                  ("--synth_phases", "max_phases")):
+                    if plan["synth"].get(key) is not None:
+                        argv += [flag, str(plan["synth"][key])]
         return argv
 
 
@@ -433,7 +453,11 @@ class Supervisor:
             overlap=self.spec.overlap, faults=self.spec.faults,
             # the relaunch gossips through the same wire codec the run
             # was stamped with — price (and re-stamp) it accordingly
-            wire=stamped.get("wire"))
+            wire=stamped.get("wire"),
+            # a synthesized run re-enters the synthesizer for the new
+            # world (stamped knobs + spec; an unchanged world reuses
+            # the stamped schedule) instead of the registry ranking
+            synth=stamped.get("synth"))
         try:
             plan = plan_for(world, ppi=stamped.get("ppi"),
                             algorithm=stamped.get("algorithm",

@@ -157,6 +157,10 @@ class CommModel:
     # messages + intra-slice grouped allreduce)
     slice_size: int | None = None
     hier: bool = False
+    # synthesized composition (topology/synthesized.py): one model phase
+    # per compiled round — edge phases priced per real message, psum
+    # phases as grouped ring-allreduces (exact payload, no codec)
+    synthesized: bool = False
     # wire codec provenance (parallel/wire.py): how payload_bytes was
     # encoded — stamped into snapshots so obsreport names the format
     # behind the byte counts
@@ -239,7 +243,62 @@ class CommModel:
                 rows.append((cross, same, hop_sum))
             return rows
 
-        if getattr(schedule, "phase_kinds", None) is not None:
+        kinds = getattr(schedule, "phase_kinds", None)
+        if kinds is not None and "inter" not in kinds:
+            # synthesized composition ("edge"/"psum" kinds): one model
+            # phase per compiled round.  Edge phases price their real
+            # messages (sparse delegate permutations send fewer than
+            # one payload per rank); psum phases ship the grouped
+            # ring-allreduce 2·(g−1)/g of the EXACT payload per member
+            # (the codec never touches a grouped collective).  Lane
+            # split by the fabric slice decomposition: a psum whose
+            # groups sit inside one slice is ICI, one spanning slices
+            # is conservatively all DCN.
+            if faults is not None:
+                raise ValueError("fault pricing is not supported on "
+                                 "synthesized schedules")
+            wire_l, ici_l, dcn_l, hop_l = [], [], [], []
+            for p, kind in enumerate(kinds):
+                if kind == "psum":
+                    groups = schedule.phase_groups[p]
+                    g = len(groups[0])
+                    b = int(round(2.0 * (g - 1) / g * exact))
+                    crosses = fabric is not None and any(
+                        len({r // fabric for r in grp}) > 1
+                        for grp in groups)
+                    wire_l.append(b)
+                    ici_l.append(0 if crosses else b)
+                    dcn_l.append(b if crosses else 0)
+                    # grouped collective over contiguous members:
+                    # nearest-neighbour, one hop per byte
+                    hop_l.append(b)
+                else:
+                    row = classify(schedule.perms[p:p + 1],
+                                   schedule.edge_weights[p:p + 1], 1,
+                                   schedule.peers_per_itr)[0]
+                    cross, same, hop_sum = row
+                    dcn = int(round(cross * msg / n))
+                    ici = int(round(same * msg / n))
+                    wire_l.append(dcn + ici)
+                    ici_l.append(ici)
+                    dcn_l.append(dcn)
+                    hop_l.append(int(round(hop_sum * msg / n)))
+            return cls(mode="gossip", world=n, ppi=1,
+                       num_phases=len(kinds),
+                       payload_bytes=payload, exact_bytes=exact,
+                       msg_overhead_bytes=overhead,
+                       gossip_every=max(1, int(gossip_every)),
+                       global_avg_every=max(0, int(global_avg_every)),
+                       slice_size=fabric, synthesized=True,
+                       wire_dtype=wire_dtype, wire_block=wire_block,
+                       error_feedback=bool(error_feedback),
+                       overlap=bool(overlap),
+                       staleness=max(1, int(staleness)),
+                       wire_bytes_per_phase=tuple(wire_l),
+                       ici_bytes_per_phase=tuple(ici_l),
+                       dcn_bytes_per_phase=tuple(dcn_l),
+                       hop_bytes_per_phase=tuple(hop_l))
+        if kinds is not None:
             # hierarchical: one model phase per compiled round
             if faults is not None:
                 raise ValueError("fault pricing is not supported on "
@@ -409,6 +468,7 @@ class CommModel:
                 "faulted": bool(self.keep_fraction_rows),
                 "slice_size": self.slice_size,
                 "hierarchical": self.hier,
+                "synthesized": self.synthesized,
                 "wire_dtype": self.wire_dtype,
                 "wire_block": self.wire_block,
                 "error_feedback": self.error_feedback,

@@ -18,6 +18,12 @@ from .hierarchical import (
 )
 from .mixing import MixingStrategy, SelfWeightedMixing, UniformMixing
 from .schedule import GossipSchedule, build_schedule, build_pairing_schedule
+from .synthesized import (
+    SynthesizedGraph,
+    SynthesizedSchedule,
+    spec_fingerprint,
+    validate_spec,
+)
 
 # Integer registry kept flag-compatible with the reference CLI
 # (gossip_sgd.py:54-67); 6 is a TPU-native addition (two-level
@@ -44,6 +50,9 @@ TOPOLOGY_NAMES = {
     "ring": RingGraph,
     "npeer-exponential": NPeerDynamicDirectedExponentialGraph,
     "hierarchical": HierarchicalGraph,
+    # searched schedule (planner/synthesize.py): constructible only from
+    # a spec, so the registry scan skips it (unsupported without one)
+    "synth": SynthesizedGraph,
 }
 
 
@@ -74,7 +83,11 @@ __all__ = [
     "RingGraph",
     "HierarchicalGraph",
     "HierarchicalSchedule",
+    "SynthesizedGraph",
+    "SynthesizedSchedule",
     "default_slice_size",
+    "spec_fingerprint",
+    "validate_spec",
     "MixingStrategy",
     "UniformMixing",
     "SelfWeightedMixing",

@@ -115,10 +115,10 @@ class GossipSchedule:
             raise ValueError(f"staleness must be >= 1, got {staleness}")
         if getattr(self, "phase_kinds", None):
             raise ValueError(
-                "overlap_schedule applies to flat schedules; the "
-                "hierarchical overlap round composes the deferred "
-                "delegate share with an intra-slice psum and has no "
-                "single augmented table form")
+                "overlap_schedule applies to flat schedules; schedules "
+                "with grouped-psum phases (hierarchical, synthesized) "
+                "compose a deferred share with an exact group collective "
+                "and have no single augmented table form")
         if staleness == 1:
             return self  # same-step consume: the effective matrix is W
         n, s = self.world_size, staleness

@@ -288,7 +288,9 @@ class Trainer:
                     registry=self.telemetry.registry,
                     interconnect=self._plan_interconnect(),
                     faults=bool(config.inject_faults),
-                    wire=self.wire_config())
+                    wire=self.wire_config(),
+                    synth=(config.plan.get("synth")
+                           if config.plan else None))
 
         # per-rank files: each process writes its local ranks; the single
         # aggregate file is process 0's job

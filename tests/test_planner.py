@@ -566,7 +566,11 @@ class TestSpectralGapCache:
         g1, g2 = spectral_gap(s1), spectral_gap(s2)
         assert g1 == g2
         info = spectral_gap_cache_info()
-        assert info == {"hits": 1, "misses": 1, "size": 1}
+        # the LRU bound (PR 12) grew the info payload: evict counter +
+        # configured max ride alongside the original hit/miss/size
+        assert (info["hits"], info["misses"], info["size"],
+                info["evictions"]) == (1, 1, 1, 0)
+        assert info["max"] >= 1
 
     def test_different_tables_miss(self):
         from stochastic_gradient_push_tpu.analysis import (
