@@ -12,6 +12,22 @@
 #   SLURM:         sbatch --nodes=1 --signal=USR1@120 \
 #                    launch/launch_supervised.sh launch_sgp.sh ...
 #
+# Fleet form (two-level supervision, scripts/fleet.py): one pod
+# coordinator plus one per-host supervisor per host, all sharing
+# FLEET_DIR on a common filesystem.  The unit of failure is a whole
+# host: the coordinator rendezvouses the survivors, assigns each its
+# shard of the cross-world reshard, and relaunches the fleet together.
+#
+#   coordinator:   FLEET_DIR=/runs/f1 bash launch/launch_supervised.sh \
+#                    fleet-coordinator --hosts 4 --rows 8
+#   host h:        FLEET_DIR=/runs/f1 bash launch/launch_supervised.sh \
+#                    fleet-host 2 launch_sgp.sh --world_size 32 \
+#                    --num_processes 4 --process_id 2 --fleet True \
+#                    --trace_dir /runs/f1/host2 ...
+#
+# (under SLURM: one fleet-host task per node via srun, the coordinator
+# on the batch host; exit 75 requeues exactly like the single form)
+#
 # The first argument names a sibling launch script (or "lm" for the LM
 # harness); everything after it is passed to the training CLI.  The
 # child MUST get a --trace_dir (the supervisor acts on the typed event
@@ -30,8 +46,38 @@ set -uo pipefail
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="$REPO_ROOT:${PYTHONPATH:-}"
 
-target="${1:?usage: launch_supervised.sh <launch_xxx.sh|lm> [child args...]}"
+target="${1:?usage: launch_supervised.sh <launch_xxx.sh|lm|fleet-coordinator|fleet-host> [args...]}"
 shift
+
+if [ "$target" = "fleet-coordinator" ]; then
+    : "${FLEET_DIR:?fleet-coordinator needs FLEET_DIR (the shared fleet directory)}"
+    # shellcheck disable=SC2086
+    exec python "$REPO_ROOT/scripts/fleet.py" --coordinator \
+        --fleet_dir "$FLEET_DIR" ${SUPERVISE_ARGS:-} "$@"
+fi
+
+if [ "$target" = "fleet-host" ]; then
+    : "${FLEET_DIR:?fleet-host needs FLEET_DIR (the shared fleet directory)}"
+    host="${1:?usage: launch_supervised.sh fleet-host <host-id> <launch_xxx.sh|lm> [child args...]}"
+    shift
+    inner="${1:?fleet-host needs a launch script (or 'lm') after the host id}"
+    shift
+    if [ "$inner" = "lm" ]; then
+        child=(python -u -m stochastic_gradient_push_tpu.run.gossip_lm "$@")
+    else
+        child=(bash "$REPO_ROOT/launch/$inner" "$@")
+    fi
+    # shellcheck disable=SC2086
+    python "$REPO_ROOT/scripts/fleet.py" --host "$host" \
+        --fleet_dir "$FLEET_DIR" ${SUPERVISE_ARGS:-} -- "${child[@]}"
+    rc=$?
+    if [ "$rc" -eq 75 ] && [ -n "${SLURM_JOB_ID:-}" ]; then
+        echo "launch_supervised: fleet host $host preempted after" \
+             "checkpoint; requeueing job $SLURM_JOB_ID" >&2
+        scontrol requeue "$SLURM_JOB_ID"
+    fi
+    exit "$rc"
+fi
 
 tag_flag=()
 if [ "$target" = "lm" ]; then

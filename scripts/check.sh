@@ -45,6 +45,10 @@ echo "== supervise self-check (elastic: kill a rank -> reshard -> relaunch) =="
 python scripts/supervise.py --selftest
 
 echo
+echo "== fleet self-check (two-level: kill a slice -> rendezvous -> coordinated reshard) =="
+python scripts/fleet.py --selftest
+
+echo
 echo "== tier-1 tests (CPU, not slow) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -33,6 +33,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from stochastic_gradient_push_tpu.telemetry import (  # noqa: E402
+    COORDINATOR_EVENTS_FILE,
     EVENTS_FILE,
     SCHEMA_VERSION,
     SUPERVISOR_EVENTS_FILE,
@@ -47,31 +48,50 @@ from stochastic_gradient_push_tpu.utils.meter import (  # noqa: E402
 
 def _event_files(run_dir: str) -> list[str]:
     """events.jsonl plus any per-process events_rN.jsonl siblings (a
-    multi-process run writes one file per rank to avoid interleaving)
-    plus the supervisor's own stream (supervisor.jsonl — the restart
-    timeline lives there)."""
+    multi-process run writes one file per rank to avoid interleaving),
+    the supervisor's own stream (supervisor.jsonl — the restart
+    timeline lives there), and, for a fleet directory, the pod
+    coordinator's broadcast stream (coordinator.jsonl — the fleet
+    timeline) plus every host's supervisor stream."""
     import glob
 
     base, ext = os.path.splitext(EVENTS_FILE)
     return sorted(
         glob.glob(os.path.join(run_dir, EVENTS_FILE))
         + glob.glob(os.path.join(run_dir, f"{base}_r*{ext}"))
-        + glob.glob(os.path.join(run_dir, SUPERVISOR_EVENTS_FILE)))
+        + glob.glob(os.path.join(run_dir, SUPERVISOR_EVENTS_FILE))
+        + glob.glob(os.path.join(run_dir, COORDINATOR_EVENTS_FILE))
+        + glob.glob(os.path.join(run_dir, "host*",
+                                 SUPERVISOR_EVENTS_FILE)))
+
+
+def _host_of(path: str, run_dir: str) -> int | None:
+    """Host index when the stream lives in a fleet host{h}/ subdir."""
+    rel = os.path.relpath(os.path.dirname(path), run_dir)
+    if rel.startswith("host") and rel[4:].isdigit():
+        return int(rel[4:])
+    return None
 
 
 def load_events(run_dir: str) -> list[dict]:
     events = []
     for path in _event_files(run_dir):
+        host = _host_of(path, run_dir)
         with open(path) as f:
             for n, line in enumerate(f, start=1):
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    events.append(json.loads(line))
+                    ev = json.loads(line)
                 except json.JSONDecodeError as e:
                     raise ValueError(
                         f"{path}:{n}: unparseable event: {e}")
+                if host is not None and isinstance(ev, dict):
+                    # provenance for fleet reports: which host's
+                    # supervisor stream this event came from
+                    ev["_host"] = host
+                events.append(ev)
     return events
 
 
@@ -214,6 +234,7 @@ def build_report(run_dir: str) -> dict:
     supervisor_evs = by_kind.get("supervisor", [])
     restart_timeline = [
         {"generation": ev["data"].get("generation"),
+         "host": ev.get("_host"),
          "world": ev["data"].get("world"),
          "prev_world": ev["data"].get("prev_world"),
          "topology": ev["data"].get("topology"),
@@ -222,6 +243,52 @@ def build_report(run_dir: str) -> dict:
          "mean_drift": ev["data"].get("mean_drift"),
          "time_to_recover_s": ev["data"].get("time_to_recover_s")}
         for ev in relaunches]
+
+    # fleet timeline: the pod coordinator's broadcast stream — one row
+    # per rendezvous round, one per committed assign→go cycle, the
+    # per-host generation count and the coordinated reshard drift
+    fleet_evs = sorted(by_kind.get("fleet", []),
+                       key=lambda ev: ev.get("t", 0.0))
+    rendezvous_evs = sorted(by_kind.get("rendezvous", []),
+                            key=lambda ev: ev.get("t", 0.0))
+    fleet = None
+    if fleet_evs or rendezvous_evs:
+        start = next((ev["data"] for ev in fleet_evs
+                      if ev["data"].get("phase") == "start"), None)
+        calls = [{"round": ev["data"].get("round"),
+                  "cause": ev["data"].get("cause"),
+                  "hosts": ev["data"].get("hosts")}
+                 for ev in rendezvous_evs
+                 if ev["data"].get("phase") == "call"]
+        gos = [ev["data"] for ev in fleet_evs
+               if ev["data"].get("phase") == "go"]
+        assigns = [ev["data"] for ev in fleet_evs
+                   if ev["data"].get("phase") == "assign"]
+        excluded = sorted({h for a in assigns
+                           for h in (a.get("excluded") or [])})
+        cycles = [{"cycle": g.get("cycle"), "round": g.get("round"),
+                   "world": g.get("world"),
+                   "prev_world": g.get("prev_world"),
+                   "generation": g.get("generation"),
+                   "acks": g.get("acks")} for g in gos]
+        hosts = sorted(int(h) for h in (start or {}).get("hosts", {}))
+        generations = {
+            str(h): 1 + sum(1 for g in gos
+                            if str(h) in (g.get("acks") or {}))
+            for h in hosts}
+        final = next((ev["data"].get("phase")
+                      for ev in reversed(fleet_evs)
+                      if ev["data"].get("phase") in
+                      ("complete", "give-up", "halt")), None)
+        fleet = {
+            "hosts": (start or {}).get("hosts"),
+            "start_world": (start or {}).get("world"),
+            "rendezvous_rounds": calls,
+            "cycles": cycles,
+            "excluded_hosts": excluded,
+            "host_generations": generations,
+            "outcome": final,
+        }
 
     report = {
         "run_dir": run_dir,
@@ -252,9 +319,17 @@ def build_report(run_dir: str) -> dict:
         "heartbeat_stalls": len(heartbeats),
         "restarts": {
             "supervised": bool(supervisor_evs or relaunches),
-            "generations": len(relaunches) + 1,
+            # a fleet merges every host's relaunch events into this
+            # timeline; counting them all as one supervisor's
+            # generations would contradict the per-host generations in
+            # the fleet section, so count per host there instead
+            "generations": (max(fleet["host_generations"].values(),
+                                default=1)
+                            if fleet and fleet["host_generations"]
+                            else len(relaunches) + 1),
             "timeline": restart_timeline,
         },
+        "fleet": fleet,
         "comm": comm_final,
         "ckpt_meta": load_ckpt_meta(run_dir),
     }
@@ -310,11 +385,39 @@ def render(report: dict) -> str:
             shape = (f"world {r['prev_world']} -> {r['world']}"
                      if r.get("prev_world") != r.get("world")
                      else f"world {r['world']}")
+            who = (f"host {r['host']} gen {r['generation']}"
+                   if r.get("host") is not None
+                   else f"gen {r['generation']}")
             lines.append(
-                f"   gen {r['generation']}: {shape}, topology "
+                f"   {who}: {shape}, topology "
                 f"{r.get('topology')}, {r.get('reason')}"
                 f" (recovered in {r.get('time_to_recover_s')}s"
                 f"{drift})")
+    fl = report.get("fleet")
+    if fl:
+        lines.append(
+            f"fleet: {len(fl['host_generations'] or {})} host(s), "
+            f"world {fl.get('start_world')}, "
+            f"{len(fl['rendezvous_rounds'])} rendezvous round(s), "
+            f"{len(fl['cycles'])} coordinated cycle(s), outcome "
+            f"{fl.get('outcome')}")
+        for call in fl["rendezvous_rounds"]:
+            lines.append(f"   round {call['round']}: "
+                         f"hosts {call['hosts']} — {call['cause']}")
+        for cy in fl["cycles"]:
+            drifts = ", ".join(
+                f"h{h}:{d:.2e}" if isinstance(d, float) else f"h{h}:-"
+                for h, d in sorted((cy.get("acks") or {}).items()))
+            lines.append(
+                f"   cycle {cy['cycle']}: world {cy['prev_world']} -> "
+                f"{cy['world']} (gen {cy['generation']}; reshard drift "
+                f"{drifts})")
+        if fl["excluded_hosts"]:
+            lines.append(f"   excluded hosts: {fl['excluded_hosts']}")
+        if fl["host_generations"]:
+            lines.append("   host generations: " + ", ".join(
+                f"h{h}={g}" for h, g in
+                sorted(fl["host_generations"].items())))
     c = report["comm"]
     if c:
         by = c.get("bytes", {})
@@ -424,6 +527,38 @@ def selftest() -> int:
             "time_to_recover_s": 2.5}, severity="warning")
         sup.close()
 
+        # a fleet run: the pod coordinator's broadcast stream renders
+        # as the fleet timeline — one slice lost, a deadline-missed
+        # rendezvous that re-ran, one coordinated reshard cycle
+        from stochastic_gradient_push_tpu.telemetry import (
+            COORDINATOR_EVENTS_FILE)
+        coord = TelemetryRegistry(rank=0, sinks=[JsonlSink(
+            os.path.join(d, COORDINATOR_EVENTS_FILE))])
+        coord.emit("fleet", {"phase": "start", "world": 6,
+                             "hosts": {"0": 2, "1": 2, "2": 2}})
+        coord.emit("rendezvous", {"phase": "call", "round": 1,
+                                  "cause": "host-silence: host 2",
+                                  "deadline_s": 2.0,
+                                  "hosts": [0, 1, 2]}, severity="warning")
+        coord.emit("rendezvous", {"phase": "call", "round": 2,
+                                  "cause": "host-silence: host 2",
+                                  "deadline_s": 2.0,
+                                  "hosts": [0, 1]}, severity="warning")
+        coord.emit("fleet", {
+            "phase": "assign", "round": 2, "cycle": 1,
+            "cause": "host-silence: host 2", "world": 4,
+            "prev_world": 6, "plan": None, "excluded": [2],
+            "shards": {"0": {"out_rank": 0, "out_rows": 2},
+                       "1": {"out_rank": 1, "out_rows": 2}}},
+            severity="warning")
+        coord.emit("fleet", {
+            "phase": "go", "round": 2, "cycle": 1, "world": 4,
+            "prev_world": 6, "generation": 1,
+            "acks": {"0": 1.4e-8, "1": 1.4e-8}}, severity="warning")
+        coord.emit("fleet", {"phase": "complete", "world": 4,
+                             "generation": 1, "cycles": 1})
+        coord.close()
+
         report = build_report(d)
         print(render(report))
 
@@ -452,6 +587,27 @@ def selftest() -> int:
                and rs["timeline"][0]["prev_world"] == 8
                and rs["timeline"][0]["topology"] == "ring",
                f"restart timeline row: {rs['timeline']}")
+        # the fleet timeline, held to the same row-level checks as the
+        # restart timeline above
+        fl = report["fleet"]
+        expect(fl is not None, "fleet timeline missing")
+        if fl is not None:
+            expect(len(fl["rendezvous_rounds"]) == 2
+                   and fl["rendezvous_rounds"][1]["hosts"] == [0, 1],
+                   f"rendezvous rounds: {fl['rendezvous_rounds']}")
+            expect(len(fl["cycles"]) == 1
+                   and fl["cycles"][0]["prev_world"] == 6
+                   and fl["cycles"][0]["world"] == 4,
+                   f"fleet cycle row: {fl['cycles']}")
+            expect(fl["excluded_hosts"] == [2],
+                   f"excluded hosts: {fl['excluded_hosts']}")
+            expect(fl["host_generations"] == {"0": 2, "1": 2, "2": 1},
+                   f"host generations: {fl['host_generations']}")
+            expect(fl["outcome"] == "complete",
+                   f"fleet outcome: {fl['outcome']}")
+            acks = fl["cycles"][0]["acks"]
+            expect(acks == {"0": 1.4e-8, "1": 1.4e-8},
+                   f"coordinated reshard drift: {acks}")
         # the analytic gate: reported bytes equal the model's expectation
         want = model.totals(num_steps)
         want["recovery"] = allreduce_bytes(payload, 8)

@@ -121,10 +121,15 @@ def parse_fault_spec(spec: str) -> "FaultPlan":
     * ``drop_random:P@T0:T1``   — drop each out-edge with probability P
     * ``straggler:R@T0:T1``     — rank R's sends all miss
     * ``blackout:R@T0:T1``      — rank R neither sends nor receives
+    * ``slice:A-B@T0:T1``       — ranks A..B (inclusive) all black out:
+      the fleet failure granularity (a whole host/slice preempted at
+      once, GossipGraD's failure model) as an in-mesh fault — sugar
+      expanding to one blackout per rank, so mass-conserving semantics
+      and the SGPV102 verifier hook apply unchanged
     * ``nan:R@T0:T1``           — rank R's outgoing payloads become NaN
     * ``seed:N``                — PRNG seed for drop_random (default 0)
 
-    Example: ``drop:0->1@10:40;straggler:3@20:30;seed:7``.
+    Example: ``drop:0->1@10:40;slice:4-7@20:30;seed:7``.
     """
     events: list[FaultEvent] = []
     seed = 0
@@ -140,9 +145,28 @@ def parse_fault_spec(spec: str) -> "FaultPlan":
         if kind == "seed":
             seed = int(rest)
             continue
-        if kind not in _KINDS:
+        if kind not in _KINDS and kind != "slice":
             raise ValueError(
-                f"unknown fault kind {kind!r}; one of {_KINDS} or seed")
+                f"unknown fault kind {kind!r}; one of {_KINDS}, "
+                "slice, or seed")
+        if kind == "slice":
+            # a whole slice of ranks blacks out together: expand to
+            # per-rank blackout events so every downstream invariant
+            # (mass-conserving reabsorption, verifier, masks) is the
+            # already-tested blackout machinery
+            body, _, window = rest.partition("@")
+            start, end = _parse_window(window, "slice")
+            if "-" not in body:
+                raise ValueError(f"slice needs A-B rank bounds, got "
+                                 f"{body!r}")
+            lo, hi = body.split("-", 1)
+            lo, hi = int(lo), int(hi)
+            if lo < 0 or hi < lo:
+                raise ValueError(
+                    f"slice bounds {body!r} must satisfy 0 <= A <= B")
+            events.extend(FaultEvent("blackout", start, end, rank=r)
+                          for r in range(lo, hi + 1))
+            continue
         body, _, window = rest.partition("@")
         start, end = _parse_window(window, kind)
         if kind == "drop":

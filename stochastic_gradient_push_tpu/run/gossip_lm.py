@@ -24,10 +24,12 @@ import os
 import time
 
 from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
-from .gossip_sgd import (add_staleness_flag, add_synth_flags,
-                         add_wire_flags, reject_push_sum_wire_knobs,
-                         resolve_staleness_flag, resolve_wire_flags,
-                         synth_plan_config, wire_plan_config)
+from .gossip_sgd import (add_fleet_flags, add_staleness_flag,
+                         add_synth_flags, add_wire_flags,
+                         reject_push_sum_wire_knobs,
+                         resolve_fleet_flags, resolve_staleness_flag,
+                         resolve_wire_flags, synth_plan_config,
+                         wire_plan_config)
 
 __all__ = ["main", "build_parser"]
 
@@ -102,6 +104,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step (communication thinning)")
     add_wire_flags(p)
+    add_fleet_flags(p)
     # optimization
     p.add_argument("--lr", default=0.5, type=float)
     p.add_argument("--momentum", default=0.9, type=float)
@@ -352,6 +355,7 @@ def main(argv=None):
     if args.metrics_every and not args.trace_dir:
         raise SystemExit("--metrics_every needs --trace_dir (telemetry "
                          "events have nowhere to go without it)")
+    resolve_fleet_flags(args)
     if args.health_every < 0:
         raise SystemExit("--health_every must be >= 0")
     if args.health_every:
@@ -714,7 +718,7 @@ def main(argv=None):
                 staleness=getattr(alg, "staleness", 1))
         rt.attach_comm(comm_model)
     if rt.enabled:
-        rt.registry.emit("run_meta", {
+        run_meta = {
             "world": world, "dp": dp, "sp": sp, "tp": tp, "ep": ep,
             "pp": pp,
             "algorithm": ("all_reduce" if sb(args.all_reduce) else
@@ -724,7 +728,13 @@ def main(argv=None):
             "batch_size": args.batch_size,
             "num_steps": args.num_steps,
             "comm_model": (rt.comm.model.to_dict()
-                           if rt.comm is not None else None)})
+                           if rt.comm is not None else None)}
+        if args.fleet:
+            run_meta["fleet"] = True
+            run_meta["host_id"] = (args.host_id
+                                   if args.host_id is not None
+                                   else proc_index)
+        rt.registry.emit("run_meta", run_meta)
 
     # checkpoint/resume: state and step counter in one atomic msgpack
     # payload (same manager as the image harness); restored leaves are
@@ -761,11 +771,14 @@ def main(argv=None):
     # harness leaves relaunching to the supervisor/launch layer
     cluster = ClusterManager(ckpt, rank=proc_index, requeue_command=None)
     if sb(args.resume) and not use_orbax and not ckpt.exists() \
-            and pp == ep == tp == 1 and sp == 1 and proc_count == 1:
+            and pp == ep == tp == 1 and sp == 1 and proc_count == 1 \
+            and not args.fleet:
         # a resized relaunch: another world's checkpoint set may exist —
         # reshard it (exact-average consensus collapse) instead of
         # silently cold-starting.  Flat dp meshes only: sharded-dim
-        # states (sp/tp/ep/pp) don't stack rank rows on dim 0
+        # states (sp/tp/ep/pp) don't stack rank rows on dim 0.  Fleet
+        # runs skip this: the pod coordinator already resharded and
+        # assigned per-host shards — a local reshard would race them
         from ..supervise.reshard import maybe_cross_world_reshard
 
         maybe_cross_world_reshard(args.checkpoint_dir, args.tag, world,

@@ -128,6 +128,42 @@ def synth_plan_config(args) -> dict | None:
             "max_phases": args.synth_phases}
 
 
+def add_fleet_flags(p: argparse.ArgumentParser) -> None:
+    """Fleet-supervision flags, shared by both run CLIs: mark this
+    process as one host of a coordinated pod (scripts/fleet.py — a
+    per-host supervisor plus a pod coordinator own the restart
+    boundary)."""
+    p.add_argument("--fleet", default="False", type=str,
+                   help="this run is one host of a coordinated fleet "
+                        "(supervise/coordinator.py): the pod "
+                        "coordinator owns cross-world resharding, so "
+                        "the per-host auto-reshard on resume is "
+                        "disabled (a racing per-host reshard is "
+                        "exactly the relaunch storm fleet supervision "
+                        "exists to prevent); host identity is stamped "
+                        "into run_meta.  Requires --trace_dir (the "
+                        "per-host supervisor acts on the typed event "
+                        "stream)")
+    p.add_argument("--host_id", default=None, type=int,
+                   help="this process's host index within the fleet "
+                        "(default: the jax process index); only "
+                        "meaningful with --fleet True")
+
+
+def resolve_fleet_flags(args) -> bool:
+    """Normalize the fleet flags in place (shared by both CLIs): coerce
+    --fleet to bool and fail fast on inconsistent combinations."""
+    fleet = _str_bool(args.fleet)
+    if args.host_id is not None and not fleet:
+        raise SystemExit("--host_id identifies this host under fleet "
+                         "supervision; it needs --fleet True")
+    if fleet and not args.trace_dir:
+        raise SystemExit("--fleet True needs --trace_dir (the per-host "
+                         "supervisor tails the typed event stream)")
+    args.fleet = fleet
+    return fleet
+
+
 def add_staleness_flag(p: argparse.ArgumentParser) -> None:
     """The overlap staleness bound, shared by both run CLIs (gossip_sgd
     and gossip_lm): the in-flight FIFO depth of the double-buffered
@@ -387,6 +423,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit a step_stats + comm telemetry event "
                         "every k steps (0 = only the final comm "
                         "snapshot); requires --trace_dir")
+    add_fleet_flags(p)
     return p
 
 
@@ -449,6 +486,7 @@ def parse_config(argv=None):
     if args.metrics_every and not args.trace_dir:
         raise SystemExit("--metrics_every needs --trace_dir (telemetry "
                          "events have nowhere to go without it)")
+    resolve_fleet_flags(args)
     # a forced name overrides the integer registry; 'auto' is resolved in
     # main() once the world size is known (planner.resolve_topology)
     graph_class = GRAPH_TOPOLOGIES[args.graph_type]
@@ -504,6 +542,8 @@ def parse_config(argv=None):
         residual_floor=args.residual_floor,
         trace_dir=args.trace_dir,
         metrics_every=args.metrics_every,
+        fleet=bool(args.fleet),
+        host_id=args.host_id,
     )
     return cfg, args
 

@@ -27,19 +27,39 @@ generalized from "requeue the same job" to "resize and replan the run"):
   boundary *by construction*;
 * :mod:`.supervisor` — the lifecycle owner: launches the training CLI as
   a managed child, drains it through the SIGUSR1 checkpoint path, and
-  relaunches with fresh ``planner.plan_for`` flags.
+  relaunches with fresh ``planner.plan_for`` flags;
+* :mod:`.replan` — the stamped-constraints replanning shared by the
+  supervisor and the coordinator (fabric, wire codec, synth spec);
+* :mod:`.coordinator` — pod-level fleet supervision: one coordinator
+  plus per-host supervisors in fleet mode, speaking a barrier-with-
+  deadline rendezvous over the typed event stream; the unit of failure
+  is a whole host/slice, survivors reshard their assigned shards
+  concurrently and relaunch on one coordinated ``go``;
+* :mod:`.hostsim` — a numpy-only per-host trainer speaking the real
+  checkpoint/event/drain contracts: the fleet chaos selftest's child.
 
-``scripts/supervise.py`` is the operator entry point;
+``scripts/supervise.py`` is the single-host operator entry point;
 ``--selftest`` runs the chaos acceptance loop (kill a rank mid-run →
 reshard 8→4 → relaunch on a fresh plan, mean preserved to f32
-tolerance) that ``scripts/check.sh`` gates on.
+tolerance) that ``scripts/check.sh`` gates on.  ``scripts/fleet.py``
+is the fleet entry point (``--coordinator`` / ``--host I``); its
+``--selftest`` kills an entire simulated slice and asserts one
+coordinated reshard/relaunch cycle at the shrunken world.
 """
 
+from .coordinator import (
+    EXCLUDED_EXIT_CODE,
+    Coordinator,
+    FleetMember,
+    host_dir,
+)
 from .policy import Action, SupervisorPolicy
+from .replan import replan_for, stamped_plan
 from .reshard import (
     ReshardReport,
     TornCheckpointError,
     consensus_mean,
+    gc_stale_tmp,
     load_world_checkpoint,
     maybe_cross_world_reshard,
     reshard_checkpoints,
@@ -54,4 +74,6 @@ __all__ = [
     "load_world_checkpoint", "maybe_cross_world_reshard",
     "reshard_checkpoints", "reshard_state",
     "ChildSpec", "Supervisor", "EventTailer",
+    "Coordinator", "FleetMember", "host_dir", "EXCLUDED_EXIT_CODE",
+    "replan_for", "stamped_plan", "gc_stale_tmp",
 ]

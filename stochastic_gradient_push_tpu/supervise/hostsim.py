@@ -1,0 +1,220 @@
+"""A simulated per-host trainer: the fleet chaos selftest's child.
+
+``scripts/fleet.py --selftest`` needs a *fleet* of children — one per
+simulated host, each owning its slice of one gossip world — that it can
+SIGKILL a whole host of and still assert exact consensus preservation
+across the coordinated reshard.  Real multi-process jax on a 2-core CI
+host is exactly the collectives-deadlock hazard the repo's test notes
+warn about, and the gossip numerics are already chaos-tested at rank
+granularity (scripts/chaos.py, scripts/supervise.py); what the *fleet*
+test must exercise is the supervision fabric: rendezvous, exclusion,
+concurrent per-host reshard, coordinated relaunch.
+
+So this module is a numpy-only trainer that speaks every host-side
+contract the real run CLIs speak, with zero accelerator footprint:
+
+* per-process checkpoint files ``{tag}checkpoint_r{proc}_n{world}.ckpt``
+  in the exact reshardable ``{state, meta}`` msgpack layout (params
+  rows + ``gossip/ps_weight`` + ``gossip/phase``), written atomically
+  with fsync-before-rename;
+* the typed event stream (``events.jsonl``: ``run_meta`` at launch,
+  ``step_stats`` per step) the per-host supervisor tails for liveness
+  and progress;
+* the SIGUSR1/SIGTERM drain contract: finish the in-flight step, save,
+  exit ``REQUEUE_EXIT_CODE`` — the checkpoint barrier;
+* ``--resume`` from its own rank file, including one another world's
+  coordinator-resharded file (rows revalidated), with the stamped
+  ``meta['plan']`` carried forward across saves.
+
+Each rank's parameters start different (seeded by global rank) and
+drift deterministically, so the world's consensus mean is a nontrivial
+quantity the reshard boundary must actually preserve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+from ..telemetry import (
+    EVENTS_FILE,
+    JsonlSink,
+    TelemetryRegistry,
+)
+from ..utils.checkpoint import REQUEUE_EXIT_CODE
+
+__all__ = ["main"]
+
+PARAM_DIM = 16
+
+
+def _ckpt_path(d: str, tag: str, proc: int, world: int) -> str:
+    return os.path.join(d, f"{tag}checkpoint_r{proc}_n{world}.ckpt")
+
+
+def _save(path: str, state: dict, meta: dict) -> None:
+    """Atomic per-process save: serialize, fsync, rename — the same
+    hygiene as supervise/reshard.py, so a SIGKILL mid-save leaves at
+    worst a stale ``.tmp.r*`` file, never a torn ``.ckpt``."""
+    import flax.serialization
+
+    payload = flax.serialization.msgpack_serialize(
+        {"state": state, "meta": meta})
+    tmp = path + f".tmp.r{meta['process_id']}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _step_update(w: np.ndarray, rank_offset: int, step: int,
+                 seed: int) -> np.ndarray:
+    """One deterministic pseudo-SGD step per rank row: reproducible for
+    a given (seed, global rank, step), different across ranks — the
+    consensus mean moves, and moves the same way on every rerun."""
+    out = w.copy()
+    for i in range(w.shape[0]):
+        rng = np.random.default_rng(
+            seed * 100_003 + (rank_offset + i) * 1_009 + step)
+        out[i] += 0.01 * rng.standard_normal(w.shape[1:]).astype(w.dtype)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hostsim",
+        description="Simulated per-host trainer for fleet supervision "
+                    "tests (numpy-only; real checkpoint + event "
+                    "contracts)")
+    ap.add_argument("--checkpoint_dir", required=True)
+    ap.add_argument("--trace_dir", required=True)
+    ap.add_argument("--tag", default="")
+    ap.add_argument("--world_size", type=int, required=True)
+    ap.add_argument("--num_processes", type=int, required=True)
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--rows", type=int, required=True,
+                    help="rank rows this host owns")
+    ap.add_argument("--rank_offset", type=int, default=None,
+                    help="first global rank of this host's rows "
+                         "(default: process_id * rows — uniform slices)")
+    ap.add_argument("--steps", type=int, default=40,
+                    help="total training steps (global counter; resume "
+                         "continues it)")
+    ap.add_argument("--save_every", type=int, default=5)
+    ap.add_argument("--step_s", type=float, default=0.05,
+                    help="simulated compute per step")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--resume", default="False")
+    args = ap.parse_args(argv)
+
+    if args.rows < 1 or args.rows > args.world_size:
+        print(f"hostsim: --rows {args.rows} outside [1, world]",
+              file=sys.stderr)
+        return 2
+    offset = (args.rank_offset if args.rank_offset is not None
+              else args.process_id * args.rows)
+
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    os.makedirs(args.trace_dir, exist_ok=True)
+    registry = TelemetryRegistry(rank=args.process_id, sinks=[
+        JsonlSink(os.path.join(args.trace_dir, EVENTS_FILE))])
+
+    signalled: list[int] = []
+    old_handlers = {
+        sig: signal.signal(sig,
+                           lambda signum, frame: signalled.append(signum))
+        for sig in (signal.SIGUSR1, signal.SIGTERM)}
+
+    # per-rank state in the reshardable layout (rows stacked on dim 0)
+    step = 0
+    plan = None
+    path = _ckpt_path(args.checkpoint_dir, args.tag, args.process_id,
+                      args.world_size)
+    state = None
+    if str(args.resume) == "True" and os.path.isfile(path):
+        import flax.serialization
+
+        with open(path, "rb") as f:
+            raw = flax.serialization.msgpack_restore(f.read())
+        state, meta = raw["state"], raw["meta"]
+        rows = int(np.asarray(state["gossip"]["ps_weight"]).shape[0])
+        if rows != args.rows:
+            print(f"hostsim: checkpoint holds {rows} rows, launched "
+                  f"with --rows {args.rows}", file=sys.stderr)
+            return 2
+        step = int(meta.get("step", 0))
+        plan = meta.get("plan")
+        state = {  # msgpack round-trips to plain dicts/ndarrays
+            "params": {"w": np.asarray(state["params"]["w"])},
+            "gossip": {
+                "ps_weight": np.asarray(state["gossip"]["ps_weight"]),
+                "phase": np.asarray(state["gossip"]["phase"])},
+        }
+    if state is None:
+        w = np.stack([
+            np.random.default_rng(args.seed * 100_003 + (offset + i))
+            .standard_normal(PARAM_DIM).astype(np.float32)
+            for i in range(args.rows)])
+        state = {
+            "params": {"w": w},
+            "gossip": {
+                "ps_weight": np.ones(args.rows, np.float32),
+                "phase": np.zeros(args.rows, np.int32)},
+        }
+
+    def meta_for(s: int) -> dict:
+        m = {"step": s, "world": args.world_size, "rows": args.rows,
+             "process_id": args.process_id,
+             "num_processes": args.num_processes, "epoch": 0, "itr": s}
+        if plan is not None:
+            m["plan"] = plan
+        return m
+
+    registry.emit("run_meta", {
+        "world": args.world_size, "algorithm": "hostsim",
+        "process_id": args.process_id,
+        "num_processes": args.num_processes,
+        "rows": args.rows, "rank_offset": offset,
+        "resumed_step": step, "fleet": True})
+
+    rc = 0
+    try:
+        while step < args.steps:
+            time.sleep(args.step_s)
+            state["params"]["w"] = _step_update(
+                state["params"]["w"], offset, step, args.seed)
+            step += 1
+            registry.emit("step_stats", {
+                "step": step,
+                "loss": float(np.abs(state["params"]["w"]).mean())},
+                step=step)
+            if signalled:
+                _save(path, state, meta_for(step))
+                registry.emit("run_meta", {
+                    "exit_reason": "preempted",
+                    "signal": int(signalled[0]),
+                    "exit_code": REQUEUE_EXIT_CODE, "step": step})
+                rc = REQUEUE_EXIT_CODE
+                break
+            if step % args.save_every == 0 or step == args.steps:
+                _save(path, state, meta_for(step))
+        else:
+            if step == 0 or step % args.save_every:
+                _save(path, state, meta_for(step))
+            registry.emit("run_meta", {
+                "exit_reason": "complete", "exit_code": 0, "step": step})
+    finally:
+        registry.close()
+        for sig, h in old_handlers.items():
+            signal.signal(sig, h)   # in-process callers (tests) recover
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Shared restart-boundary replanning: stamped constraints → fresh plan.
+
+Both relaunch deciders — the single-host :class:`~.supervisor.Supervisor`
+and the pod-level :class:`~.coordinator.Coordinator` — must re-plan for
+the surviving world under exactly the constraints the run launched with:
+the fabric model, wire codec, fault-injection and synthesizer spec are
+read back from the plan the launch stamped into the checkpoint metadata,
+so a compressed run relaunches priced on encoded lanes, a synthesized
+run re-enters the synthesizer seeded with its stamped spec, and a
+fault-injected run is never advised onto a schedule it would reject.
+
+This module is that logic, extracted so the coordinator re-plans ONCE
+for the whole fleet (per-host supervisors receive the plan in the
+``fleet`` assignment broadcast instead of each re-deriving it) and the
+two paths can never drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["stamped_plan", "replan_for"]
+
+
+def stamped_plan(checkpoint_dir: str, tag: str) -> dict | None:
+    """The plan the run launched with, read back from the newest
+    checkpoint metadata (both run CLIs stamp ``meta['plan']``)."""
+    from .reshard import _rank_files
+
+    sets = _rank_files(checkpoint_dir, tag)
+    paths = [p for files in sets.values() for _, p in files]
+    if not paths:
+        return None
+    import flax.serialization
+
+    newest = max(paths, key=os.path.getmtime)
+    try:
+        with open(newest, "rb") as f:
+            raw = flax.serialization.msgpack_restore(f.read())
+    except (OSError, ValueError):
+        return None
+    if isinstance(raw, dict) and isinstance(raw.get("meta"), dict):
+        return raw["meta"].get("plan")
+    return None
+
+
+def replan_for(world: int, stamped: dict | None, *,
+               gossip: bool = True, algorithm: str = "sgp",
+               gap_floor: float = 0.01, overlap: bool = False,
+               faults: bool = False, log=None) -> dict | None:
+    """A fresh ``planner.plan_for`` for ``world`` under the stamped
+    constraints; ``None`` for non-gossip runs (nothing to plan) or when
+    the planner cannot help (the relaunch then keeps the child's own
+    flags).  ``stamped`` is the previous generation's plan dict (from
+    :func:`stamped_plan`); the child-derived keyword defaults fill the
+    gaps when the stamp is missing (e.g. a legacy launch)."""
+    if not gossip:
+        return None
+    from ..planner import InterconnectModel, PlanConstraints, plan_for
+
+    stamped = stamped or {}
+    interconnect = None
+    if stamped.get("interconnect"):
+        interconnect = InterconnectModel.from_dict(
+            stamped["interconnect"])
+    cons = PlanConstraints(
+        floor=float(stamped.get("floor", gap_floor)),
+        self_weighted=bool(stamped.get("alpha") is not None),
+        interconnect=interconnect,
+        overlap=overlap, faults=faults,
+        # the relaunch gossips through the same wire codec the run
+        # was stamped with — price (and re-stamp) it accordingly
+        wire=stamped.get("wire"),
+        # a synthesized run re-enters the synthesizer for the new
+        # world (stamped knobs + spec; an unchanged world reuses
+        # the stamped schedule) instead of the registry ranking
+        synth=stamped.get("synth"))
+    try:
+        plan = plan_for(world, ppi=stamped.get("ppi"),
+                        algorithm=stamped.get("algorithm", algorithm),
+                        constraints=cons)
+    except ValueError as e:
+        if log is not None:
+            log.warning("replan failed (%s); relaunching with the "
+                        "child's own flags", e)
+        return None
+    if log is not None:
+        log.info("replan for world %d: %s", world, plan.summary())
+    return plan.to_dict()

@@ -44,15 +44,24 @@ import dataclasses
 import json
 import os
 import re
+import time
 import typing as tp
 
 import numpy as np
 
 __all__ = ["TornCheckpointError", "ReshardReport", "load_world_checkpoint",
            "consensus_mean", "reshard_state", "reshard_checkpoints",
-           "maybe_cross_world_reshard"]
+           "maybe_cross_world_reshard", "gc_stale_tmp"]
 
 _CKPT_RE = re.compile(r"^checkpoint_r(\d+)_n(\d+)\.ckpt$")
+# a writer's in-flight atomic-rename staging file; see gc_stale_tmp
+_TMP_RE = re.compile(r"^checkpoint_r\d+_n\d+\.ckpt\.tmp\.r\d+$")
+
+# how old a *.ckpt.tmp.r{rank} file must be before readers may garbage-
+# collect it: long enough that a LIVE concurrent writer (a fleet of
+# hosts resharding their shards at once) is never raced, short enough
+# that a killed writer's droppings don't outlive the next relaunch
+STALE_TMP_AGE_S = 60.0
 
 
 class TornCheckpointError(RuntimeError):
@@ -75,6 +84,38 @@ def _map_leaves(tree: tp.Any, fn, path: tuple = ()):
         return {k: _map_leaves(v, fn, path + (str(k),))
                 for k, v in tree.items()}
     return fn(path, tree)
+
+
+def gc_stale_tmp(directory: str, tag: str = "",
+                 older_than_s: float = STALE_TMP_AGE_S) -> list[str]:
+    """Remove stale ``{tag}checkpoint_*.ckpt.tmp.r*`` staging files.
+
+    A writer SIGKILLed mid-:func:`reshard_checkpoints` (or a host lost
+    mid-save) leaves its atomic-rename staging file behind.  The
+    ``.ckpt``-set readers never *consider* these (the filename regexes
+    are anchored on ``.ckpt``), but on preemptible capacity they
+    accumulate forever, so the readers garbage-collect any older than
+    ``older_than_s`` — the age guard keeps a live concurrent writer's
+    in-flight tmp safe.  Returns the removed paths."""
+    removed: list[str] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return removed
+    now = time.time()
+    for name in names:
+        if tag and not name.startswith(tag):
+            continue
+        if not _TMP_RE.match(name[len(tag):]):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(path) > older_than_s:
+                os.remove(path)
+                removed.append(path)
+        except OSError:
+            continue  # raced another reader's GC, or the writer's rename
+    return removed
 
 
 def _rank_files(directory: str, tag: str) -> dict[int, list[tuple[int, str]]]:
@@ -111,6 +152,7 @@ def load_world_checkpoint(directory: str, tag: str, world: int
     """
     import flax.serialization
 
+    gc_stale_tmp(directory, tag)
     files = _rank_files(directory, tag).get(world, [])
     if not files:
         raise TornCheckpointError(
@@ -352,6 +394,12 @@ def reshard_checkpoints(directory: str, tag: str, old_world: int,
     tmp = out_path + f".tmp.r{out_rank}"
     with open(tmp, "wb") as f:
         f.write(flax.serialization.msgpack_serialize(payload))
+        f.flush()
+        # the rename below is only atomic-durable if the DATA is on
+        # disk first: without the fsync a power loss can leave the new
+        # name pointing at a hole — a torn file the torn-set check
+        # cannot see (its rows still parse)
+        os.fsync(f.fileno())
     os.replace(tmp, out_path)
     return dataclasses.replace(report, files_out=(out_path,))
 
@@ -365,6 +413,7 @@ def maybe_cross_world_reshard(directory: str, tag: str, world: int,
     compatible set into place and return its report (None = nothing
     usable; torn sets are rejected and skipped).  Called by both run
     CLIs before deciding to cold-start."""
+    gc_stale_tmp(directory, tag)
     sets = _rank_files(directory, tag)
     if world in sets:
         return None  # an exact-world set exists; normal restore wins

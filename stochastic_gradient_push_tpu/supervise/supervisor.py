@@ -36,6 +36,19 @@ renders them as the restart timeline.
 A preemption signal (SIGTERM/SIGUSR1) to the *supervisor* drains the
 child and exits with ``REQUEUE_EXIT_CODE`` itself, so an outer
 scheduler (launch/launch_supervised.sh) can requeue the whole job.
+
+**Fleet mode** (``fleet=FleetMember(...)``): the supervisor is one host
+of a pod and relaunch decisions belong to the pod-level
+:class:`~.coordinator.Coordinator`.  Detection stays local — the same
+policy watches the same child stream — but instead of resharding and
+relaunching on its own, the supervisor reports the fault, answers the
+coordinator's rendezvous calls (draining or burying its child first),
+reshards exactly the ``out_rank``/``out_rows`` shard the assignment
+names (concurrently with every other survivor), acks, and relaunches
+only on the coordinator's ``go`` — so a pod-wide failure produces one
+coordinated cycle, never a per-host relaunch storm.  Between relaunch
+cycles it heartbeats ``rendezvous alive`` events (with the child pid)
+so the coordinator can tell a dead host from a quiet one.
 """
 
 from __future__ import annotations
@@ -43,7 +56,6 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
-import sys
 import time
 
 from ..telemetry import (
@@ -55,7 +67,9 @@ from ..telemetry import (
 )
 from ..utils.checkpoint import REQUEUE_EXIT_CODE
 from ..utils.logging import make_logger
+from .coordinator import EXCLUDED_EXIT_CODE
 from .policy import Action, SupervisorPolicy
+from .replan import replan_for, stamped_plan
 from .reshard import TornCheckpointError, reshard_checkpoints
 from .tailer import EventTailer
 
@@ -136,9 +150,11 @@ class ChildSpec:
         self.gap_floor = float(_flag_value(argv, "--gap_floor") or 0.01)
 
     def build_argv(self, world: int, plan: dict | None,
-                   resume: bool) -> list[str]:
+                   resume: bool, extra: dict | None = None) -> list[str]:
         """The generation's launch command: managed flags rewritten, the
-        rest of the operator's command preserved verbatim."""
+        rest of the operator's command preserved verbatim.  ``extra``
+        maps additional flags to values (a fleet assignment rewrites
+        ``--num_processes``/``--process_id`` this way)."""
         argv = _strip_flag(self.argv, "--requeue_command")
         argv = _set_flag(argv, "--world_size", world)
         argv = _set_flag(argv, "--trace_dir", self.trace_dir)
@@ -178,6 +194,8 @@ class ChildSpec:
                                   ("--synth_phases", "max_phases")):
                     if plan["synth"].get(key) is not None:
                         argv += [flag, str(plan["synth"][key])]
+        for name, value in (extra or {}).items():
+            argv = _set_flag(argv, name, value)
         return argv
 
 
@@ -193,9 +211,15 @@ class Supervisor:
                  child_env: dict | None = None,
                  install_signal_handlers: bool = True,
                  chaos_kill_after_checkpoint: bool = False,
+                 fleet=None, fleet_timeout_s: float = 600.0,
                  on_relaunch=None, log=None):
         self.spec = spec
         self.policy = policy or SupervisorPolicy(world=spec.world)
+        # fleet mode: a FleetMember (supervise/coordinator.py) — this
+        # supervisor is one host of a pod; relaunch decisions come from
+        # the coordinator's broadcast stream instead of being made here
+        self.fleet = fleet
+        self.fleet_timeout_s = fleet_timeout_s
         self.poll_interval_s = poll_interval_s
         self.drain_timeout_s = drain_timeout_s
         # > 0: a live child with NO event traffic for this long counts as
@@ -223,8 +247,16 @@ class Supervisor:
             LoggerCompatSink(self.log)])
         self.tailer = EventTailer(os.path.join(spec.trace_dir,
                                                EVENTS_FILE))
+        if self.fleet is not None:
+            self.fleet.bind(self.registry)
         self._preempted = False
         self._child: subprocess.Popen | None = None
+        self._fleet_call: dict | None = None
+        # broadcast events polled but not yet acted on: a poll() batch
+        # can carry more than the event we return on (call + assign in
+        # one flush), and the tailer never re-delivers — the remainder
+        # must survive into the fleet-cycle loop
+        self._fleet_backlog: list[dict] = []
 
     # -- signals -----------------------------------------------------------
 
@@ -241,6 +273,28 @@ class Supervisor:
                             "generation": self.policy.generation,
                             "world": self.policy.world, **data},
                            severity=severity)
+
+    def _emit_relaunch(self, *, world: int, prev_world: int, reason: str,
+                       plan: dict | None, report, t_detect: float,
+                       backoff_s: float = 0.0, **extra):
+        """The generation-boundary event — ONE schema for the
+        single-host and fleet paths (obsreport's restart timeline
+        parses exactly these keys)."""
+        self.registry.emit("relaunch", {
+            "generation": self.policy.generation,
+            "world": world, "prev_world": prev_world,
+            "reason": reason,
+            "topology": plan.get("topology") if plan else None,
+            "global_avg_every": (plan.get("global_avg_every")
+                                 if plan else None),
+            "mixing_alpha": plan.get("alpha") if plan else None,
+            "slice_size": plan.get("slice_size") if plan else None,
+            "resharded": report is not None,
+            "mean_drift": (report.mean_drift if report is not None
+                           else None),
+            "backoff_s": round(backoff_s, 3),
+            "time_to_recover_s": round(time.time() - t_detect, 3),
+            **extra}, severity="warning")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -261,29 +315,45 @@ class Supervisor:
 
     def _run(self) -> int:
         plan: dict | None = None
+        extra: dict | None = None
         resume = False
         while True:
-            argv = self.spec.build_argv(self.policy.world, plan, resume)
+            argv = self.spec.build_argv(self.policy.world, plan, resume,
+                                        extra=extra)
             self._emit("launch", reason="initial" if resume is False
                        else "relaunch")
             self.log.info("launching generation %d (world %d): %s",
                           self.policy.generation, self.policy.world,
                           " ".join(argv))
             self._child = subprocess.Popen(argv, env=self.child_env)
+            if self.fleet is not None:
+                self.fleet.hello(world=self.policy.world,
+                                 generation=self.policy.generation,
+                                 child_pid=self._child.pid)
             action = self._watch()
             if action.kind == "complete":
+                if self.fleet is not None:
+                    self.fleet.done(0)
                 self._emit("run-complete", reason=action.reason)
                 return 0
-            if action.kind == "give-up":
-                self._emit("gave-up", severity="error",
-                           reason=action.reason)
-                self._kill_child()
-                return 1
             if action.kind == "preempt-exit":
                 self._drain_child()
                 self._emit("preempt-exit", severity="warning",
                            reason=action.reason)
                 return REQUEUE_EXIT_CODE
+            if self.fleet is not None:
+                # fleet mode: the coordinator owns the relaunch cycle
+                outcome = self._fleet_cycle(action)
+                if isinstance(outcome, int):
+                    return outcome
+                plan, extra = outcome
+                resume = True
+                continue
+            if action.kind == "give-up":
+                self._emit("gave-up", severity="error",
+                           reason=action.reason)
+                self._kill_child()
+                return 1
             # a relaunch cycle: drain/kill, reshard, replan, go again
             t_detect = time.time()
             self._emit("restart-decision", severity="warning",
@@ -313,23 +383,22 @@ class Supervisor:
                     "no reshardable checkpoint (%s); relaunching cold "
                     "at world %d", e, new_world)
             prev_world = self.policy.world
-            self.policy.mark_relaunched(new_world)
-            self.registry.emit("relaunch", {
-                "generation": self.policy.generation,
-                "world": new_world, "prev_world": prev_world,
-                "reason": action.reason,
-                "topology": plan.get("topology") if plan else None,
-                "global_avg_every": (plan.get("global_avg_every")
-                                     if plan else None),
-                "mixing_alpha": plan.get("alpha") if plan else None,
-                "slice_size": plan.get("slice_size") if plan else None,
-                "resharded": report is not None,
-                "mean_drift": (report.mean_drift if report is not None
-                               else None),
-                "time_to_recover_s": round(time.time() - t_detect, 3),
-            }, severity="warning")
+            # a crash/stall is a failure for backoff purposes; a healthy
+            # drain (requeue, sustained replan) relaunches immediately
+            self.policy.mark_relaunched(new_world,
+                                        failure=action.kind == "restart")
+            backoff_s = self.policy.next_backoff_s()
+            self._emit_relaunch(world=new_world, prev_world=prev_world,
+                                reason=action.reason, plan=plan,
+                                report=report, t_detect=t_detect,
+                                backoff_s=backoff_s)
             if self.on_relaunch is not None:
                 self.on_relaunch(report, plan)
+            if backoff_s > 0:
+                self.log.info("relaunch backoff: sleeping %.2fs "
+                              "(%d consecutive failure(s))", backoff_s,
+                              self.policy.consecutive_failures)
+                time.sleep(backoff_s)
             resume = True
 
     # -- child management --------------------------------------------------
@@ -351,6 +420,12 @@ class Supervisor:
             for ev in self.tailer.poll():
                 last_event_t = time.time()
                 act = self.policy.observe(ev)
+                if act is not None:
+                    return act
+            if self.fleet is not None:
+                self.fleet.maybe_alive(child.pid if child.poll() is None
+                                       else None)
+                act = self._check_fleet_stream()
                 if act is not None:
                     return act
             if self._preempted:
@@ -380,6 +455,159 @@ class Supervisor:
                     and self.tailer.events_seen > seen_at_launch):
                 return self.policy.on_stale(time.time() - last_event_t)
             time.sleep(self.poll_interval_s)
+
+    # -- fleet mode --------------------------------------------------------
+
+    def _check_fleet_stream(self) -> Action | None:
+        """Coordinator broadcasts observed while the child is healthy:
+        a rendezvous call (another host died — drain and join) or a
+        fleet halt (pod preemption).  Whatever follows the returned-on
+        event in the same poll batch is kept for the fleet-cycle loop."""
+        batch = self._fleet_backlog + self.fleet.poll()
+        self._fleet_backlog = []
+        for i, ev in enumerate(batch):
+            data = ev.get("data") or {}
+            phase = data.get("phase")
+            if ev.get("kind") == "rendezvous" and phase == "call":
+                self._fleet_call = data
+                self._fleet_backlog.extend(batch[i + 1:])
+                return Action("fleet-rendezvous",
+                              reason="coordinator rendezvous call "
+                                     f"(round {data.get('round')}: "
+                                     f"{data.get('cause', '?')})")
+            if ev.get("kind") == "fleet" and phase == "halt":
+                return Action("preempt-exit",
+                              reason="coordinator halted the fleet")
+        return None
+
+    def _fleet_cycle(self, action: Action):
+        """One host's side of the coordinated relaunch cycle: report
+        (or answer) the fault, rendezvous, reshard the assigned shard,
+        ack, and wait for go.  Returns ``(plan, extra_flags)`` to
+        relaunch with, or an exit code to propagate."""
+        t_detect = time.time()
+        self._emit("restart-decision", severity="warning",
+                   reason=action.reason, kind=action.kind)
+        if action.kind in ("fleet-rendezvous", "drain-restart",
+                           "relaunch"):
+            # healthy child (or one that already checkpointed): the
+            # SIGUSR1 barrier is the clean shard boundary
+            self._drain_child()
+        else:
+            self._kill_child()
+        if action.kind != "fleet-rendezvous":
+            self.fleet.fault(reason=action.reason, action=action.kind)
+        # discard the dead generation's event tail (same discipline as
+        # the single-host path: stale suggestions must not leak)
+        self.tailer.poll()
+        if self._fleet_call is not None:
+            self.fleet.join(self._fleet_call["round"])
+            self._fleet_call = None
+        assign = None
+        deadline = time.time() + self.fleet_timeout_s
+        while True:
+            batch = self._fleet_backlog + self.fleet.poll()
+            self._fleet_backlog = []
+            if batch:
+                # the timeout guards against a DEAD coordinator, not a
+                # long cycle: any broadcast traffic (a re-run barrier,
+                # another survivor's slow ack window) re-arms it
+                deadline = time.time() + self.fleet_timeout_s
+            for i, ev in enumerate(batch):
+                data = ev.get("data") or {}
+                phase = data.get("phase")
+                if ev.get("kind") == "rendezvous" and phase == "call":
+                    # every (re-)run of the barrier supersedes whatever
+                    # assignment was in flight
+                    assign = None
+                    self.fleet.join(data["round"])
+                elif ev.get("kind") == "fleet" and phase == "assign":
+                    shard = (data.get("shards") or {}).get(
+                        str(self.fleet.host))
+                    if shard is not None:
+                        assign = data
+                        self._fleet_reshard(assign, shard)
+                    elif self.fleet.host in (data.get("excluded") or []):
+                        self._emit("excluded", severity="warning",
+                                   reason="coordinator excluded this "
+                                          "host from the new world")
+                        return EXCLUDED_EXIT_CODE
+                elif ev.get("kind") == "fleet" and phase == "go" \
+                        and assign is not None \
+                        and data.get("round") == assign.get("round"):
+                    # the batch tail (e.g. an immediately-following
+                    # rendezvous call) survives into the next
+                    # generation's _check_fleet_stream — the tailer
+                    # never re-delivers
+                    self._fleet_backlog.extend(batch[i + 1:])
+                    return self._fleet_relaunch(assign, action, t_detect)
+                elif ev.get("kind") == "fleet" and phase in (
+                        "halt", "give-up", "complete"):
+                    self._emit("fleet-exit", severity="warning",
+                               reason=f"coordinator {phase}")
+                    return (REQUEUE_EXIT_CODE if phase == "halt" else 1)
+            if self._preempted:
+                self._emit("preempt-exit", severity="warning",
+                           reason="supervisor received a preemption "
+                                  "signal mid-rendezvous")
+                return REQUEUE_EXIT_CODE
+            if time.time() > deadline:
+                self._emit("fleet-timeout", severity="error",
+                           reason="no coordinator broadcast traffic "
+                                  f"for {self.fleet_timeout_s:.0f}s")
+                return 1
+            time.sleep(self.poll_interval_s)
+
+    def _fleet_reshard(self, assign: dict, shard: dict) -> None:
+        """Reshard this host's assigned shard of the cross-world
+        collapse — run CONCURRENTLY by every survivor (disjoint
+        ``out_rank``/``out_rows`` writes compose into one un-torn set) —
+        then ack with the measured boundary drift."""
+        report = None
+        try:
+            report = reshard_checkpoints(
+                self.spec.checkpoint_dir, self.spec.tag,
+                assign["prev_world"], assign["world"],
+                out_rank=shard["out_rank"], out_rows=shard["out_rows"],
+                plan=assign.get("plan"))
+            self.log.warning(
+                "fleet reshard: n=%d -> n=%d, my shard r%d (%d rows), "
+                "mean drift %.2e", assign["prev_world"],
+                assign["world"], shard["out_rank"], shard["out_rows"],
+                report.mean_drift)
+        except (TornCheckpointError, ValueError) as e:
+            self.log.warning("fleet reshard found no usable source set "
+                             "(%s); relaunching cold", e)
+        self._fleet_report = report
+        self.fleet.ack(assign["round"], ok=report is not None,
+                       mean_drift=(report.mean_drift
+                                   if report is not None else None),
+                       out_rank=shard["out_rank"],
+                       out_rows=shard["out_rows"])
+
+    def _fleet_relaunch(self, assign: dict, action: Action, t_detect):
+        """The coordinator committed: adopt the assignment and hand the
+        relaunch flags back to the generation loop."""
+        shard = assign["shards"][str(self.fleet.host)]
+        prev_world = self.policy.world
+        self.policy.mark_relaunched(assign["world"], failure=False)
+        plan = assign.get("plan")
+        report = getattr(self, "_fleet_report", None)
+        self._emit_relaunch(
+            world=assign["world"], prev_world=prev_world,
+            reason=f"fleet-assign ({assign.get('cause', '?')})",
+            plan=plan, report=report, t_detect=t_detect,
+            out_rank=shard["out_rank"], out_rows=shard["out_rows"])
+        extra = {"--num_processes": shard["num_hosts"],
+                 "--process_id": shard["host_index"]}
+        # children that address their rows explicitly (the host-sim
+        # trainer) get them rewritten too; real run CLIs derive rank
+        # ownership from the process layout and never pass these
+        if _flag_value(self.spec.argv, "--rows") is not None:
+            extra["--rows"] = shard["out_rows"]
+        if _flag_value(self.spec.argv, "--rank_offset") is not None:
+            extra["--rank_offset"] = shard["rank_offset"]
+        return plan, extra
 
     def _drain_child(self) -> int | None:
         """SIGUSR1 → wait for the checkpoint barrier (the child exits
@@ -413,59 +641,16 @@ class Supervisor:
     # -- replanning --------------------------------------------------------
 
     def _stamped_plan(self) -> dict | None:
-        """The plan the run launched with, read back from the newest
-        checkpoint metadata (both CLIs stamp ``meta['plan']``)."""
-        from .reshard import _rank_files
-
-        sets = _rank_files(self.spec.checkpoint_dir, self.spec.tag)
-        paths = [p for files in sets.values() for _, p in files]
-        if not paths:
-            return None
-        import flax.serialization
-
-        newest = max(paths, key=os.path.getmtime)
-        try:
-            with open(newest, "rb") as f:
-                raw = flax.serialization.msgpack_restore(f.read())
-        except (OSError, ValueError):
-            return None
-        if isinstance(raw, dict) and isinstance(raw.get("meta"), dict):
-            return raw["meta"].get("plan")
-        return None
+        """The plan the run launched with (supervise/replan.py)."""
+        return stamped_plan(self.spec.checkpoint_dir, self.spec.tag)
 
     def _replan(self, world: int) -> dict | None:
         """A fresh ``planner.plan_for`` for ``world`` under the run's
-        stamped constraints; None for non-gossip children (nothing to
-        plan) or when the planner cannot help."""
-        if not self.spec.gossip:
-            return None
-        from ..planner import InterconnectModel, PlanConstraints, plan_for
-
-        stamped = self._stamped_plan() or {}
-        interconnect = None
-        if stamped.get("interconnect"):
-            interconnect = InterconnectModel.from_dict(
-                stamped["interconnect"])
-        cons = PlanConstraints(
-            floor=float(stamped.get("floor", self.spec.gap_floor)),
-            self_weighted=bool(stamped.get("alpha") is not None),
-            interconnect=interconnect,
-            overlap=self.spec.overlap, faults=self.spec.faults,
-            # the relaunch gossips through the same wire codec the run
-            # was stamped with — price (and re-stamp) it accordingly
-            wire=stamped.get("wire"),
-            # a synthesized run re-enters the synthesizer for the new
-            # world (stamped knobs + spec; an unchanged world reuses
-            # the stamped schedule) instead of the registry ranking
-            synth=stamped.get("synth"))
-        try:
-            plan = plan_for(world, ppi=stamped.get("ppi"),
-                            algorithm=stamped.get("algorithm",
-                                                  self.spec.algorithm),
-                            constraints=cons)
-        except ValueError as e:
-            self.log.warning("replan failed (%s); relaunching with the "
-                             "child's own flags", e)
-            return None
-        self.log.info("replan for world %d: %s", world, plan.summary())
-        return plan.to_dict()
+        stamped constraints (supervise/replan.py — the same helper the
+        pod coordinator re-plans the whole fleet with)."""
+        return replan_for(world, self._stamped_plan(),
+                          gossip=self.spec.gossip,
+                          algorithm=self.spec.algorithm,
+                          gap_floor=self.spec.gap_floor,
+                          overlap=self.spec.overlap,
+                          faults=self.spec.faults, log=self.log)

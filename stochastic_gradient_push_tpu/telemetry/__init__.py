@@ -44,6 +44,7 @@ __all__ = [
     "CommModel", "CommAccountant", "tree_payload_bytes",
     "encoded_payload_bytes", "allreduce_bytes", "COMM_CATEGORIES",
     "TRACE_FILE", "EVENTS_FILE", "SUPERVISOR_EVENTS_FILE",
+    "COORDINATOR_EVENTS_FILE",
 ]
 
 TRACE_FILE = "trace.json"
@@ -53,6 +54,11 @@ EVENTS_FILE = "events.jsonl"
 # supervisor TAILS events.jsonl while the child appends to it, and must
 # neither race the child's writes nor read back its own
 SUPERVISOR_EVENTS_FILE = "supervisor.jsonl"
+# the pod coordinator's broadcast stream (kinds rendezvous/fleet): every
+# per-host supervisor tails it for rendezvous calls and fleet decisions,
+# while the coordinator tails each host's supervisor.jsonl — the two
+# directions never share a file, so nobody reads back its own writes
+COORDINATOR_EVENTS_FILE = "coordinator.jsonl"
 
 
 def _rank_file(name: str, rank: int) -> str:

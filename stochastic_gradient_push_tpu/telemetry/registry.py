@@ -45,6 +45,8 @@ EVENT_KINDS = frozenset({
     "bench",        # benchmark artifact lines (bench.py modes)
     "supervisor",   # run-supervisor lifecycle decision (supervise/)
     "relaunch",     # one generation boundary: reshard + replan + respawn
+    "rendezvous",   # fleet host<->coordinator barrier protocol message
+    "fleet",        # pod-coordinator decision (assign/go/complete/halt)
 })
 
 SEVERITIES = ("info", "warning", "error")
@@ -56,6 +58,8 @@ LEGACY_PREFIXES = {
     "health": "gossip health",
     "recovery": "gossip recovery",
     "supervisor": "gossip supervisor",
+    "rendezvous": "gossip rendezvous",
+    "fleet": "gossip fleet",
 }
 
 

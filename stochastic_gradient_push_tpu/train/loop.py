@@ -141,6 +141,14 @@ class TrainerConfig:
     resume: bool = False
     checkpoint_all: bool = True
     overwrite_checkpoints: bool = True
+    # fleet supervision (supervise/coordinator.py): this process is one
+    # host of a coordinated pod.  The pod coordinator owns the restart
+    # boundary — it assigns each survivor its out_rank/out_rows shard of
+    # the cross-world reshard — so the per-host auto-reshard on resume
+    # is DISABLED (concurrent per-host reshards with default out_rank 0
+    # would race each other: the relaunch storm fleet mode prevents)
+    fleet: bool = False
+    host_id: int | None = None
 
     num_classes: int = 1000
     # hierarchical gossip: exact psum averaging inside a node, gossip
@@ -505,7 +513,7 @@ class Trainer:
                 overlap=getattr(alg, "overlap", False),
                 staleness=getattr(alg, "staleness", 1))
         self.telemetry.attach_comm(model)
-        self.telemetry.registry.emit("run_meta", {
+        meta = {
             "world": self.gossip_world, "algorithm": alg_name,
             "gossip_every": cfg.gossip_every,
             "global_avg_every": cfg.global_avg_every,
@@ -513,7 +521,14 @@ class Trainer:
             "itr_per_epoch": itr_per_epoch,
             "num_epochs": cfg.num_epochs,
             "scan_steps": cfg.scan_steps,
-            "comm_model": model.to_dict()})
+            "comm_model": model.to_dict()}
+        if cfg.fleet:
+            # fleet supervision: the coordinator's obsreport timeline
+            # maps event streams to hosts through this stamp
+            meta["fleet"] = True
+            meta["host_id"] = (cfg.host_id if cfg.host_id is not None
+                               else self.proc_index)
+        self.telemetry.registry.emit("run_meta", meta)
 
     # -- csv logging -------------------------------------------------------
 
@@ -775,6 +790,13 @@ class Trainer:
         (assembled rank rows must sum to the source world), and on a pod
         the existing all-gather barrier in fit() still vetoes a resume
         any process could not complete."""
+        if self.cfg.fleet:
+            # the pod coordinator already resharded (and assigned this
+            # host its shard) before relaunching; a per-host reshard
+            # here would race the other survivors' writes
+            self.log.info("fleet mode: cross-world auto-reshard left "
+                          "to the pod coordinator")
+            return False
         ckpt = self.cluster.ckpt
         if not hasattr(ckpt, "discover_worlds"):
             return False  # backend without flat per-rank files (orbax)

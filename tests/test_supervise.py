@@ -474,6 +474,109 @@ class TestSupervisorPolicy:
 # -- child spec / argv handling ----------------------------------------------
 
 
+class TestRelaunchBackoffAndRefill:
+    def test_healthy_relaunch_has_no_backoff(self):
+        p = SupervisorPolicy(world=4)
+        assert p.next_backoff_s() == 0.0
+        p.mark_relaunched(4, failure=False)   # requeue / replan drain
+        assert p.next_backoff_s() == 0.0
+
+    def test_failure_backoff_is_exponential_capped_and_deterministic(self):
+        def policy():
+            return SupervisorPolicy(world=4, backoff_base_s=2.0,
+                                    backoff_max_s=30.0,
+                                    backoff_jitter=0.5)
+
+        a, b = policy(), policy()
+        seen = []
+        for _ in range(6):
+            a.mark_relaunched(4, failure=True)
+            b.mark_relaunched(4, failure=True)
+            # deterministic: two identical policies pace identically
+            assert a.next_backoff_s() == b.next_backoff_s()
+            seen.append(a.next_backoff_s())
+        # exponential ramp with jitter in [1, 1.5), capped at the max
+        for k, s in enumerate(seen):
+            raw = 2.0 * 2.0 ** k
+            assert min(30.0, raw) <= s <= min(30.0, raw * 1.5)
+        assert seen[-1] == 30.0  # the cap
+        assert seen == sorted(seen)
+
+    def test_jitter_desynchronizes_generations(self):
+        p = SupervisorPolicy(world=4, backoff_base_s=1.0,
+                             backoff_max_s=1e9, backoff_jitter=0.5)
+        fracs = []
+        for _ in range(4):
+            p.mark_relaunched(4, failure=True)
+            k = p.consecutive_failures
+            fracs.append(p.next_backoff_s() / (2.0 ** (k - 1)))
+        assert len(set(fracs)) == len(fracs)  # no lockstep
+
+    def test_jitter_salt_desynchronizes_hosts(self):
+        # a pod-wide transient crashes every host at the SAME
+        # generation; the per-host salt must spread their backoffs
+        # (identical salts still pace identically — determinism holds)
+        def policy(salt):
+            p = SupervisorPolicy(world=4, backoff_base_s=1.0,
+                                 backoff_max_s=1e9, backoff_jitter=0.5,
+                                 jitter_salt=salt)
+            p.mark_relaunched(4, failure=True)
+            return p.next_backoff_s()
+
+        backoffs = [policy(h) for h in range(4)]
+        assert len(set(backoffs)) == 4
+        # MEANINGFULLY spread, not micro-distinct floats: with jitter
+        # 0.5 the factor spans [1, 1.5) — hosts must use a real chunk
+        # of that range or the herd still lands together
+        assert max(backoffs) - min(backoffs) > 0.05
+        assert policy(2) == policy(2)
+
+    def test_healthy_relaunch_resets_the_failure_streak(self):
+        p = SupervisorPolicy(world=4, backoff_base_s=1.0,
+                             backoff_jitter=0.0)
+        p.mark_relaunched(4, failure=True)
+        p.mark_relaunched(4, failure=True)
+        assert p.next_backoff_s() == 2.0
+        p.mark_relaunched(4, failure=False)
+        assert p.next_backoff_s() == 0.0
+
+    def test_progress_refills_the_restart_budget(self):
+        p = SupervisorPolicy(world=4, max_restarts=2, refill_steps=10)
+        p.mark_relaunched(4, failure=True)
+        p.mark_relaunched(4, failure=True)
+        # budget spent: the next incident would give up...
+        assert p.on_child_exit(1).kind == "give-up"
+        # ...but sustained healthy progress refills it: 10 observed
+        # steps since the relaunch restore the full budget
+        p.observe(_ev(step=3))
+        assert p.restarts == 2              # baseline only, no credit
+        p.observe(_ev(step=8))
+        assert p.restarts == 2              # window not yet spanned
+        p.observe(_ev(step=13))
+        assert p.restarts == 0
+        assert p.consecutive_failures == 0
+        assert p.on_child_exit(1).kind == "restart"
+
+    def test_refill_window_restarts_after_each_relaunch(self):
+        p = SupervisorPolicy(world=4, max_restarts=1, refill_steps=10)
+        p.observe(_ev(step=100))            # pre-crash progress
+        p.mark_relaunched(4, failure=True)
+        # the relaunched child resumes at a LOWER step; the old
+        # baseline must not credit the jump backwards
+        p.observe(_ev(step=50))
+        p.observe(_ev(step=59))
+        assert p.restarts == 1
+        p.observe(_ev(step=60))
+        assert p.restarts == 0
+
+    def test_refill_disabled_keeps_hard_cap(self):
+        p = SupervisorPolicy(world=4, max_restarts=1, refill_steps=0)
+        p.mark_relaunched(4, failure=True)
+        p.observe(_ev(step=10 ** 6))
+        assert p.restarts == 1
+        assert p.on_child_exit(1).kind == "give-up"
+
+
 class TestChildSpec:
     ARGV = ["python", "-m", "stochastic_gradient_push_tpu.run.gossip_sgd",
             "--world_size", "8", "--trace_dir", "/runs/t",
