@@ -62,6 +62,15 @@ BENCH_GVA_WIRE="f32,int8" plus BENCH_GVA_WIRE_BLOCK / BENCH_GVA_EF)
 adds a wire-codec sweep: the same gossip step timed per codec with the
 modeled ENCODED bytes (int8 scale overhead included) alongside — the
 calibration artifact for the planner's wire-fraction pricing.
+BENCH_GVA_KERNEL (auto|pallas|xla, also honored by --overlap-vs-sync)
+selects the gossip transport lane and both artifacts stamp the resolved
+``kernel``; the lane moves identical modeled bytes by construction, so
+only measured ms may differ.  Caveat carried from the r04/r05 rounds:
+those headline values are CACHED on-chip captures (live TPU was
+unreachable at bench time), and the pallas kernel lane's measured-ms
+win likewise needs a live-TPU capture — on the CPU test backend the
+kernel runs through the Pallas interpreter, so its step time there is
+a correctness artifact, not a measurement.
 
 Third mode — ``python bench.py --synth-vs-registry``: model-only
 artifact for the planner's schedule *synthesizer* (planner/
@@ -381,6 +390,23 @@ def run_measurement() -> dict:
     return out
 
 
+def _resolve_bench_kernel():
+    """(KernelLane | None, "pallas" | "xla") from BENCH_GVA_KERNEL —
+    the gossip transport lane for both --gossip-vs-ar and
+    --overlap-vs-sync.  An explicit ``pallas`` off-TPU runs through the
+    Pallas interpreter (correctness lane, honest-but-slow ms); ``auto``
+    keeps the production rule (pallas on TPU, xla elsewhere)."""
+    import jax
+
+    from stochastic_gradient_push_tpu.ops.gossip_kernel import (
+        resolve_gossip_kernel)
+
+    flag = os.environ.get("BENCH_GVA_KERNEL", "auto")
+    interpret = flag == "pallas" and jax.default_backend() != "tpu"
+    lane = resolve_gossip_kernel(flag, interpret=interpret)
+    return lane, ("pallas" if lane is not None else "xla")
+
+
 def run_gossip_vs_ar() -> dict:
     """Gossip + periodic exact averaging vs AllReduce-every-step.
 
@@ -420,6 +446,7 @@ def run_gossip_vs_ar() -> dict:
     warmup = max(1, int(os.environ.get("BENCH_GVA_WARMUP", "3")))
     ga = max(1, int(os.environ.get("BENCH_GVA_GA", "8")))
     topology = os.environ.get("BENCH_GVA_TOPOLOGY", "ring")
+    kernel_lane, kernel_name = _resolve_bench_kernel()
     image, classes = 16, 10
 
     mesh = make_gossip_mesh(world)
@@ -474,7 +501,8 @@ def run_gossip_vs_ar() -> dict:
         return tracer.durations(label)[-1] / steps * 1e3
 
     sgp_ms = timed_ms("sgp_ga_steps",
-                      sgp(schedule, GOSSIP_AXIS, global_avg_every=ga))
+                      sgp(schedule, GOSSIP_AXIS, global_avg_every=ga,
+                          gossip_kernel=kernel_lane))
     ar_ms = timed_ms("allreduce_steps", all_reduce(GOSSIP_AXIS))
 
     # model the TIMED ticks: the algorithm's step counter has already
@@ -506,11 +534,13 @@ def run_gossip_vs_ar() -> dict:
             ms = timed_ms(
                 f"sgp_ga_steps_{wd}",
                 sgp(schedule, GOSSIP_AXIS, global_avg_every=ga,
-                    wire=codec, error_feedback=ef))
+                    wire=codec, error_feedback=ef,
+                    gossip_kernel=kernel_lane))
         enc = encoded_payload_bytes(params_tmpl, world, codec)
         modeled = CommModel.from_schedule(
             schedule, enc, exact_bytes=payload, global_avg_every=ga,
-            codec=codec, error_feedback=ef).totals(steps, start=warmup)
+            codec=codec, error_feedback=ef,
+            gossip_kernel=kernel_name).totals(steps, start=warmup)
         wire_sweep.append({
             "wire_dtype": wd,
             **({"wire_block": wire_block} if wd == "int8" else {}),
@@ -533,6 +563,9 @@ def run_gossip_vs_ar() -> dict:
         "speedup_vs_ar": round(ar_ms / sgp_ms, 3) if sgp_ms else None,
         "global_avg_every": ga,
         "topology": topology,
+        # the gossip transport lane that moved the bytes (modeled bytes
+        # are lane-independent by construction; only measured ms moves)
+        "kernel": kernel_name,
         "world": world,
         "batch": batch,
         "steps": steps,
@@ -610,6 +643,7 @@ def run_overlap_vs_sync() -> dict:
     warmup = max(1, int(os.environ.get("BENCH_OVS_WARMUP", "4")))
     reps = max(1, int(os.environ.get("BENCH_OVS_REPS", "3")))
     staleness = max(1, int(os.environ.get("BENCH_OVS_STALENESS", "2")))
+    kernel_lane, kernel_name = _resolve_bench_kernel()
     classes = 10
 
     mesh = make_gossip_mesh(world)
@@ -638,9 +672,9 @@ def run_overlap_vs_sync() -> dict:
         return fn, st
 
     modes = {
-        "sync": sgp(schedule, GOSSIP_AXIS),
+        "sync": sgp(schedule, GOSSIP_AXIS, gossip_kernel=kernel_lane),
         "overlap": sgp(schedule, GOSSIP_AXIS, overlap=True,
-                       staleness=staleness),
+                       staleness=staleness, gossip_kernel=kernel_lane),
     }
     built = {name: build(alg) for name, alg in modes.items()}
     final_state = {}
@@ -693,11 +727,12 @@ def run_overlap_vs_sync() -> dict:
     parity = float(np.abs(mean_o - mean_s).max() / max(scale, 1e-12))
 
     payload = tree_payload_bytes(built["sync"][1].params, world)
-    sync_bytes = CommModel.from_schedule(schedule, payload).totals(
+    sync_bytes = CommModel.from_schedule(
+        schedule, payload, gossip_kernel=kernel_name).totals(
         steps, start=warmup)
     over_bytes = CommModel.from_schedule(
-        schedule, payload, overlap=True, staleness=staleness).totals(
-        steps, start=warmup)
+        schedule, payload, overlap=True, staleness=staleness,
+        gossip_kernel=kernel_name).totals(steps, start=warmup)
 
     out = {
         "metric": "overlap_vs_sync_step_ms",
@@ -707,6 +742,9 @@ def run_overlap_vs_sync() -> dict:
         "speedup_vs_sync": round(sync_ms / overlap_ms, 3)
         if overlap_ms else None,
         "staleness": staleness,
+        # the gossip transport lane both modes ran (BENCH_GVA_KERNEL);
+        # bytes are lane-independent, only measured ms may move
+        "kernel": kernel_name,
         "world": world,
         "batch": batch,
         "image": image,
@@ -737,7 +775,12 @@ def run_overlap_vs_sync() -> dict:
         out["note"] = ("cpu backend: collectives are blocking, so the "
                        "overlap win is not observable here; the "
                        "overlap-vs-sync TPU capture is the headline "
-                       "measurement")
+                       "measurement.  The same caveat covers the kernel "
+                       "lane: BENCH_r04/r05 headline values are cached "
+                       "on-chip captures, and the pallas lane's "
+                       "measured-ms win needs a live-TPU capture — on "
+                       "cpu the kernel runs through the Pallas "
+                       "interpreter (correctness, not speed)")
     out_path = os.environ.get(
         "BENCH_OVS_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -797,6 +840,10 @@ def overlap_vs_sync_main(selftest: bool) -> int:
         failures.append(
             f"modeled comm bytes differ between modes ({modeled}); "
             "overlap must re-time the wire, never re-price it")
+    if result.get("kernel") not in ("pallas", "xla"):
+        failures.append(
+            f"artifact kernel lane {result.get('kernel')!r} missing or "
+            "unknown; the transport lane must be stamped (pallas|xla)")
     if result["consensus_parity_rel"] > 0.05:
         failures.append(
             f"consensus parity {result['consensus_parity_rel']} "
@@ -809,7 +856,8 @@ def overlap_vs_sync_main(selftest: bool) -> int:
     print(f"overlap-vs-sync selftest: OK (overlap "
           f"{result['value']} ms vs sync {result['sync_step_ms']} ms, "
           f"speedup {result['speedup_vs_sync']}x, parity "
-          f"{result['consensus_parity_rel']}, bytes equal)", flush=True)
+          f"{result['consensus_parity_rel']}, bytes equal, "
+          f"kernel {result['kernel']})", flush=True)
     return 0
 
 

@@ -29,8 +29,12 @@ echo "== chaos self-check (resilience: faults -> monitor -> recovery) =="
 python scripts/chaos.py --selftest
 
 echo
-echo "== wire self-check (int8 + error-feedback gossip wire) =="
+echo "== wire self-check (int8 + error-feedback gossip wire, incl. kernel lane) =="
 python scripts/wirecheck.py --selftest
+
+echo
+echo "== gossip-kernel self-check (fused Pallas edge kernel, interpret mode) =="
+python scripts/gossipkernel.py --selftest
 
 echo
 echo "== overlap self-check (double-buffered gossip vs sync step time) =="

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""gossipkernel — fused Pallas gossip kernel: the CI selftest.
+
+Usage:
+    python scripts/gossipkernel.py --selftest
+
+Exit codes: 0 clean, 1 selftest failure.
+
+The selftest pins the interpret-mode kernel on a world-8 virtual CPU
+mesh: the fused remote-DMA transport (ops/gossip_kernel.py) must be
+bit-identical to the XLA ppermute on the f32 passthrough lane and
+within f32 tolerance on the int8 in-kernel dequant lane (same scales,
+same op order), across a chunked payload with a ragged tail; and the
+``--gossip_kernel pallas`` resolver must reject a non-TPU backend with
+the typed KernelBackendError instead of a Mosaic crash.
+"""
+
+import os
+import signal
+import sys
+
+# die quietly when piped into `head` instead of tracebacking
+signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+
+# the selftest needs a world-8 mesh: force the virtual CPU platform
+# BEFORE jax loads (same pattern as scripts/wirecheck.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stochastic_gradient_push_tpu.ops.gossip_kernel import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

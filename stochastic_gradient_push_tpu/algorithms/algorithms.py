@@ -182,7 +182,8 @@ class PushSumGossip(GossipAlgorithm):
                  overlap: bool = False, track_weight: bool = True,
                  gossip_every: int = 1, comm_dtype=None,
                  staleness: int = 1, global_avg_every: int = 0,
-                 faults=None, wire=None, error_feedback: bool = False):
+                 faults=None, wire=None, error_feedback: bool = False,
+                 gossip_kernel=None):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
@@ -277,6 +278,17 @@ class PushSumGossip(GossipAlgorithm):
                     "(track_weight=True); the push-pull path carries "
                     "no residual state")
         self.error_feedback = bool(error_feedback)
+        # fused Pallas transport (ops/gossip_kernel.py): accept the CLI
+        # flag string ("auto"/"pallas"/"xla") or an already-resolved
+        # KernelLane; None = the XLA ppermute lane.  Resolution happens
+        # HERE — construction time — so gossip_kernel="pallas" on a
+        # backend that cannot lower the kernel fails with the typed
+        # KernelBackendError before anything compiles.
+        if isinstance(gossip_kernel, str):
+            from ..ops.gossip_kernel import resolve_gossip_kernel
+
+            gossip_kernel = resolve_gossip_kernel(gossip_kernel)
+        self.gossip_kernel = gossip_kernel
 
     # -- helpers -----------------------------------------------------------
 
@@ -290,13 +302,13 @@ class PushSumGossip(GossipAlgorithm):
             out = collectives.mix_push_sum(
                 params, ps_weight, phase, self.schedule, self.axis_name,
                 codec=self.wire, faults=self.faults, tick=tick,
-                ef_residual=residual)
+                ef_residual=residual, kernel=self.gossip_kernel)
             if residual is None:
                 return out[0], out[1], None
             return out
         return (collectives.mix_push_pull(
             params, phase, self.schedule, self.axis_name,
-            codec=self.wire), ps_weight, None)
+            codec=self.wire, kernel=self.gossip_kernel), ps_weight, None)
 
     def _launch(self, params, ps_weight, rotation, tick, residual):
         """Launch one double-buffered round (collectives.overlap_launch):
@@ -310,13 +322,14 @@ class PushSumGossip(GossipAlgorithm):
         if residual is None:
             local, incoming = collectives.overlap_launch(
                 tree, rotation, self.schedule, self.axis_name,
-                codec=self.wire, faults=self.faults, tick=tick)
+                codec=self.wire, faults=self.faults, tick=tick,
+                kernel=self.gossip_kernel)
             return local[0], local[1], incoming, None
         full_res = (residual, jax.tree.map(jnp.zeros_like, ps_weight))
         local, incoming, new_res = collectives.overlap_launch(
             tree, rotation, self.schedule, self.axis_name,
             codec=self.wire, faults=self.faults, tick=tick,
-            ef_residual=full_res)
+            ef_residual=full_res, kernel=self.gossip_kernel)
         return local[0], local[1], incoming, new_res[0]
 
     # -- algorithm slots ---------------------------------------------------
@@ -550,7 +563,8 @@ class PushPullGossip(PushSumGossip):
 
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, staleness: int = 1,
-                 global_avg_every: int = 0, faults=None):
+                 global_avg_every: int = 0, faults=None,
+                 gossip_kernel=None):
         if not schedule.regular:
             raise ValueError("D-PSGD requires a regular schedule "
                              "(doubly-stochastic mixing)")
@@ -565,7 +579,8 @@ class PushPullGossip(PushSumGossip):
                 "edges (use --push_sum True)")
         super().__init__(schedule, axis_name, overlap=overlap,
                          track_weight=overlap, staleness=staleness,
-                         global_avg_every=global_avg_every)
+                         global_avg_every=global_avg_every,
+                         gossip_kernel=gossip_kernel)
 
 
 class BilateralGossip(GossipAlgorithm):
@@ -603,26 +618,31 @@ def sgp(schedule: GossipSchedule, axis_name: str,
         overlap: bool = False, gossip_every: int = 1,
         comm_dtype=None, staleness: int = 1,
         global_avg_every: int = 0, faults=None, wire=None,
-        error_feedback: bool = False) -> PushSumGossip:
+        error_feedback: bool = False,
+        gossip_kernel=None) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
                          gossip_every=gossip_every, comm_dtype=comm_dtype,
                          staleness=staleness,
                          global_avg_every=global_avg_every, faults=faults,
-                         wire=wire, error_feedback=error_feedback)
+                         wire=wire, error_feedback=error_feedback,
+                         gossip_kernel=gossip_kernel)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str,
-         staleness: int = 1) -> PushSumGossip:
+         staleness: int = 1, gossip_kernel=None) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=True,
-                         staleness=staleness)
+                         staleness=staleness,
+                         gossip_kernel=gossip_kernel)
 
 
 def dpsgd(schedule: GossipSchedule, axis_name: str,
           overlap: bool = False, staleness: int = 1,
-          global_avg_every: int = 0, faults=None) -> PushPullGossip:
+          global_avg_every: int = 0, faults=None,
+          gossip_kernel=None) -> PushPullGossip:
     return PushPullGossip(schedule, axis_name, overlap=overlap,
                           staleness=staleness,
-                          global_avg_every=global_avg_every, faults=faults)
+                          global_avg_every=global_avg_every, faults=faults,
+                          gossip_kernel=gossip_kernel)
 
 
 def adpsgd(pairing: np.ndarray, axis_name: str) -> BilateralGossip:

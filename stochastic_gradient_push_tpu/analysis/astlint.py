@@ -75,10 +75,17 @@ _KEY_REFRESHERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data"}
 _TELEMETRY_ATTRS = {"span", "instant", "trace_complete", "emit",
                     "emit_comm"}
 
-# the one module allowed to put dtype casts on the gossip wire (SGPL010):
+# the modules allowed to put dtype casts on the gossip wire (SGPL010):
 # parallel/wire.py owns every encode/decode, so pricing and the compiled
-# cast can never disagree
-_WIRE_CAST_EXEMPT_SUFFIX = "parallel/wire.py"
+# cast can never disagree; ops/gossip_kernel.py is the codec's IN-KERNEL
+# decode — the fused Pallas receive reconstructs WireCodec.decode in
+# VMEM, the one other place a wire cast legitimately lives
+_WIRE_CAST_EXEMPT_SUFFIXES = ("parallel/wire.py", "ops/gossip_kernel.py")
+
+# wire-boundary call whose payload arguments SGPL010 also checks: the
+# fused kernel ships its ``parts`` exactly like a ppermute payload, so
+# an inline .astype there is the same single-encode-path violation
+_KERNEL_WIRE_BOUNDARY = "gossip_edge_axpy"
 
 _SUPPRESS_RE = re.compile(r"#\s*sgplint:\s*disable=([A-Za-z0-9_,\s]+|all)")
 
@@ -394,30 +401,40 @@ class _Linter(ast.NodeVisitor):
             self._check_host_effect(node, name)
             self._check_telemetry_emission(node)
             if name == "jax.lax.ppermute":
-                self._check_wire_cast(node)
+                self._check_wire_cast(node, [node.args[0]]
+                                      if node.args else [])
+            elif name is not None and (
+                    name == _KERNEL_WIRE_BOUNDARY
+                    or name.endswith("." + _KERNEL_WIRE_BOUNDARY)):
+                # the fused-kernel wire boundary: acc (arg 0) and the
+                # encoded parts (arg 1) both ride the interconnect
+                self._check_wire_cast(node, list(node.args[:2]))
         self.generic_visit(node)
 
-    # -- SGPL010: raw wire cast on a ppermute payload ----------------------
+    # -- SGPL010: raw wire cast on a wire-boundary payload -----------------
 
-    def _check_wire_cast(self, node: ast.Call) -> None:
-        """An ``.astype(...)`` anywhere inside a ppermute's payload
-        expression is an inline wire cast — the single-encode-path
-        invariant says every such cast lives in parallel/wire.py, where
-        pricing (telemetry/comm.py) and error feedback see it too."""
-        if self.relpath.replace("\\", "/").endswith(
-                _WIRE_CAST_EXEMPT_SUFFIX):
+    def _check_wire_cast(self, node: ast.Call, payloads) -> None:
+        """An ``.astype(...)`` anywhere inside a wire payload expression
+        — a ``ppermute`` argument or the fused gossip kernel's
+        acc/parts — is an inline wire cast.  The single-encode-path
+        invariant says every such cast lives in parallel/wire.py (the
+        codecs) or ops/gossip_kernel.py (the codecs' in-kernel decode),
+        where pricing (telemetry/comm.py) and error feedback see it."""
+        rel = self.relpath.replace("\\", "/")
+        if rel.endswith(_WIRE_CAST_EXEMPT_SUFFIXES):
             return
-        if not node.args:
-            return
-        for n in ast.walk(node.args[0]):
-            if isinstance(n, ast.Call) \
-                    and isinstance(n.func, ast.Attribute) \
-                    and n.func.attr == "astype":
-                self.add(node, "SGPL010",
-                         "raw .astype() wire cast on a ppermute payload "
-                         "— wire encoding belongs to a parallel/wire.py "
-                         "WireCodec (single-encode-path invariant)")
-                return
+        for payload in payloads:
+            for n in ast.walk(payload):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "astype":
+                    self.add(node, "SGPL010",
+                             "raw .astype() wire cast on a gossip wire "
+                             "payload (ppermute / gossip_edge_axpy) — "
+                             "wire encoding belongs to a "
+                             "parallel/wire.py WireCodec "
+                             "(single-encode-path invariant)")
+                    return
 
     # -- SGPL009: telemetry emission in traced code ------------------------
 

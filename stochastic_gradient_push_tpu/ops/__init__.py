@@ -5,6 +5,16 @@ from .flash_attention import (
     flash_attention_backward,
     flash_attention_forward,
 )
+from .gossip_kernel import (
+    GOSSIP_KERNELS,
+    KernelBackendError,
+    KernelLane,
+    gossip_edge_axpy,
+    resolve_gossip_kernel,
+    resolve_use_pallas,
+)
 
 __all__ = ["flash_attention", "flash_attention_forward",
-           "flash_attention_backward"]
+           "flash_attention_backward", "GOSSIP_KERNELS",
+           "KernelBackendError", "KernelLane", "gossip_edge_axpy",
+           "resolve_gossip_kernel", "resolve_use_pallas"]

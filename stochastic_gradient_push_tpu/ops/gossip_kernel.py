@@ -1,0 +1,418 @@
+"""Fused Pallas gossip edge kernel: remote DMA + in-receive decode + axpy.
+
+The schedule-level half of hiding the gossip exchange shipped with the
+overlap phase schedule (``collectives.overlap_launch``); this module
+closes the kernel-level half.  The XLA path round-trips every encoded
+payload through HBM three times per edge: ``ppermute`` ships the wire
+bytes, a separate decode pass materializes the full-precision payload,
+and a separate axpy folds it into the accumulator.  Here one
+``pl.pallas_call`` per (edge, leaf) does all three as a single fused op:
+
+* **transport** — the flattened encoded payload is chunked over a grid;
+  each grid step issues one ``pltpu.make_async_remote_copy`` per wire
+  part (the int8 scale side-lane is its own part) straight from the
+  sender's HBM into the destination rank's receive buffer, signalled by
+  per-chunk send/recv DMA semaphores (the SNIPPETS.md [2] right-permute
+  pattern, generalized to an arbitrary static destination table);
+* **in-receive decode** — the received chunk is DMA'd into VMEM and
+  decoded there: f32 passthrough, bf16 widen, int8 per-block dequant
+  against the scale side-lane (``parallel/wire.py`` owns the encode;
+  the decode spec the codec exposes is interpreted here);
+* **mixing axpy** — ``acc += w·decode(chunk)`` accumulates directly in
+  VMEM (the mixing weight rides the sender multiply of the
+  column-stochastic round, so the receive-side ``w`` is the identity),
+  and only the updated accumulator block is written back.  The DECODED
+  payload never materializes in HBM; the receive buffer holds encoded
+  bytes only (~1 B/elem at int8 instead of 4).
+
+Selection follows the ``ops/ring_flash.py`` convention through the
+shared :func:`resolve_use_pallas` rule — Pallas on TPU (or under
+``interpret=True``, which runs the identical kernel through the Pallas
+interpreter so the world-8 CPU test mesh exercises the real remote-DMA
+path), XLA ``ppermute`` everywhere else — and the XLA fallback stays
+selectable at runtime (``--gossip_kernel xla``) and bit-compared in CI.
+``resolve_gossip_kernel`` maps the CLI flag onto a :class:`KernelLane`
+and rejects ``pallas`` on a backend that cannot lower Mosaic remote DMA
+with a typed :class:`KernelBackendError` instead of a Mosaic crash.
+
+Numerics: the kernel branch reuses the exact send pipeline of the XLA
+path — the sender multiply, fault keep-masks, EF residual injection and
+the codec ``encode`` all happen before the payload reaches the kernel,
+so the error-feedback residual telescopes against the same sent bytes
+— and the in-VMEM decode performs the same elementwise ops in the same
+order as ``WireCodec.decode``, so interpret-mode output is bit-aligned
+with the XLA path (pinned by tests and the wirecheck kernel lane).  The
+push-sum weight lane (scalar leaves) never enters the kernel: it ships
+exact f32 over ``lax.ppermute`` in both lanes, bit-identical by
+construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KernelBackendError", "KernelLane", "GOSSIP_KERNELS",
+           "DEFAULT_CHUNK_ELEMS", "resolve_use_pallas",
+           "resolve_gossip_kernel", "gossip_edge_axpy", "main"]
+
+# CLI vocabulary for --gossip_kernel
+GOSSIP_KERNELS = ("auto", "pallas", "xla")
+
+# elements of decoded payload per remote-copy chunk: 64k f32 elements is
+# a 256 KB VMEM working set per buffered part — deep enough to amortize
+# DMA issue cost, shallow enough to leave VMEM for the train step
+DEFAULT_CHUNK_ELEMS = 64 * 1024
+
+# ceiling on chunks per call (bounds the per-chunk DMA semaphore
+# arrays); larger payloads get proportionally larger chunks
+_MAX_CHUNKS = 256
+
+
+class KernelBackendError(RuntimeError):
+    """``--gossip_kernel pallas`` on a backend that cannot run it."""
+
+
+def resolve_use_pallas(flag: bool | None, interpret: bool) -> bool:
+    """The shared kernel-selection auto rule (ops/ring_flash.py and the
+    gossip kernel resolve through this one function): an explicit flag
+    wins; ``None`` means Pallas on TPU — or whenever ``interpret`` is
+    set, which routes the identical kernel through the Pallas
+    interpreter (the CPU test path) — and the non-kernel fallback
+    elsewhere."""
+    if flag is None:
+        return bool(interpret) or jax.default_backend() == "tpu"
+    return bool(flag)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelLane:
+    """Resolved Pallas lane for the gossip collective: carried by the
+    algorithm/collective layers wherever the kernel branch is active
+    (absence — ``None`` — is the XLA ppermute lane)."""
+
+    interpret: bool = False
+    chunk_elems: int = DEFAULT_CHUNK_ELEMS
+
+    @property
+    def name(self) -> str:
+        return "pallas"
+
+
+def resolve_gossip_kernel(flag: str | None,
+                          interpret: bool = False) -> KernelLane | None:
+    """Map the ``--gossip_kernel`` flag onto a lane.
+
+    ``"xla"``/``None`` → ``None`` (the ppermute path).  ``"auto"`` →
+    a :class:`KernelLane` exactly when :func:`resolve_use_pallas` says
+    the kernel can run (TPU, or ``interpret``).  ``"pallas"`` → a lane,
+    or a typed :class:`KernelBackendError` on a backend where the
+    Mosaic remote-DMA kernel cannot lower — failing at resolve time
+    with a readable message instead of a Mosaic crash at first step.
+    """
+    if flag is None or flag == "xla":
+        return None
+    if flag == "auto":
+        if resolve_use_pallas(None, interpret):
+            return KernelLane(interpret=bool(interpret))
+        return None
+    if flag == "pallas":
+        if not resolve_use_pallas(None, interpret):
+            raise KernelBackendError(
+                "gossip_kernel='pallas' needs a TPU backend: the fused "
+                "gossip kernel's remote DMA only lowers through Mosaic "
+                f"(current backend: {jax.default_backend()!r}).  Use "
+                "gossip_kernel=auto for the XLA ppermute fallback, or "
+                "interpret=True (tests) to run the kernel through the "
+                "Pallas interpreter")
+        return KernelLane(interpret=bool(interpret))
+    raise ValueError(
+        f"unknown gossip_kernel {flag!r}; one of {GOSSIP_KERNELS}")
+
+
+# -- chunk layout -----------------------------------------------------------
+
+
+def _chunk_layout(n_decoded: int, block: int | None, chunk_elems: int):
+    """(chunk_rows R, elems per chunk C, num chunks NB) for a payload of
+    ``n_decoded`` elements.  With an int8 ``block`` a chunk is a whole
+    number of codec blocks so every scale stays chunk-local; the chunk
+    target grows when the payload would otherwise exceed the semaphore
+    ceiling."""
+    blk = int(block) if block else 1
+    rows_total = max(1, -(-n_decoded // blk))   # ceil: codec blocks
+    # a chunk never exceeds the payload: padding is bounded by one
+    # chunk's ragged tail, not by the chunk target
+    rows_per_chunk = max(1, min(int(chunk_elems) // blk, rows_total))
+    nb = -(-rows_total // rows_per_chunk)
+    if nb > _MAX_CHUNKS:
+        rows_per_chunk = -(-rows_total // _MAX_CHUNKS)
+        nb = -(-rows_total // rows_per_chunk)
+    return rows_per_chunk, rows_per_chunk * blk, nb
+
+
+def _pad_rows(a, rows: int):
+    """Zero-pad the leading dim to ``rows`` (symmetric codecs keep
+    decode(0) == 0, so padding never leaks into the axpy)."""
+    if a.shape[0] == rows:
+        return a
+    pad = [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad)
+
+
+# -- the kernel -------------------------------------------------------------
+
+
+def _edge_axpy_kernel(kind: str, nparts: int, out_dtype,
+                      dst_ref, acc_ref, *refs):
+    """One grid step: remote-copy this chunk of every wire part to the
+    destination rank, pull the received chunk into VMEM, decode, and
+    accumulate into the output block.
+
+    Ref layout (after the SMEM destination scalar and the pipelined
+    accumulator block): ``refs = (*part_refs, out_ref, *recv_bufs,
+    *vmem_bufs, *send_sems, *recv_sems, copy_sem)``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    part_refs = refs[:nparts]
+    out_ref = refs[nparts]
+    scratch = refs[nparts + 1:]
+    recv_bufs = scratch[:nparts]
+    vmem_bufs = scratch[nparts:2 * nparts]
+    send_sems = scratch[2 * nparts:3 * nparts]
+    recv_sems = scratch[3 * nparts:4 * nparts]
+    copy_sem = scratch[4 * nparts]
+
+    k = pl.program_id(0)
+    dst = dst_ref[0]
+
+    # transport: chunk k of every part rides one remote DMA to the
+    # destination; waiting the descriptor waits BOTH our send drain and
+    # our own recv semaphore — signalled by whichever rank holds us as
+    # its destination (the permutation is a bijection, so exactly one)
+    rdmas = []
+    for i in range(nparts):
+        rdmas.append(pltpu.make_async_remote_copy(
+            src_ref=part_refs[i].at[pl.ds(k, 1)],
+            dst_ref=recv_bufs[i].at[pl.ds(k, 1)],
+            send_sem=send_sems[i].at[k],
+            recv_sem=recv_sems[i].at[k],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        ))
+    for r in rdmas:
+        r.start()
+    for r in rdmas:
+        r.wait()
+
+    # receive side: encoded chunk HBM -> VMEM (the only HBM residency of
+    # the received payload is its ENCODED form in recv_bufs)
+    for i in range(nparts):
+        cp = pltpu.make_async_copy(recv_bufs[i].at[pl.ds(k, 1)],
+                                   vmem_bufs[i], copy_sem)
+        cp.start()
+        cp.wait()
+
+    # in-VMEM decode + mixing axpy; elementwise op order matches
+    # WireCodec.decode exactly (bit parity with the XLA lane)
+    if kind == "int8":
+        q = vmem_bufs[0][0].astype(jnp.float32)        # [R, block]
+        scale = vmem_bufs[1][0]                        # [R]
+        dec = (q * scale[:, None]).reshape(1, -1).astype(out_dtype)
+    else:  # "f32" passthrough / "bf16" widen — one astype covers both
+        dec = vmem_bufs[0][0].reshape(1, -1).astype(out_dtype)
+    out_ref[...] = acc_ref[...] + dec
+
+
+def _edge_axpy_call(kind: str, interpret: bool, dst, acc_chunks,
+                    parts_chunks):
+    """Build and invoke the pallas_call for one edge/leaf payload whose
+    chunking is already laid out (acc ``[NB, C]``, each part
+    ``[NB, ...]`` — the shapes alone carry the layout)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, c = acc_chunks.shape
+    nparts = len(parts_chunks)
+    kernel = functools.partial(_edge_axpy_kernel, kind, nparts,
+                               acc_chunks.dtype)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(acc_chunks.shape, acc_chunks.dtype),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] +
+                 [pl.BlockSpec((1, c), lambda k: (k, 0),
+                               memory_space=pltpu.VMEM)] +
+                 [pl.BlockSpec(memory_space=pltpu.ANY)] * nparts,
+        out_specs=pl.BlockSpec((1, c), lambda k: (k, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=(
+            [pltpu.ANY(p.shape, p.dtype) for p in parts_chunks] +
+            [pltpu.VMEM((1,) + p.shape[1:], p.dtype)
+             for p in parts_chunks] +
+            [pltpu.SemaphoreType.DMA((nb,))] * (2 * nparts) +
+            [pltpu.SemaphoreType.DMA(())]),
+        # the out block keeps the call live through DCE; collective_id
+        # coordinates the remote-DMA buffer addresses across the SPMD
+        # programs on a real mesh
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+        interpret=interpret,
+    )(dst, acc_chunks, *parts_chunks)
+
+
+def gossip_edge_axpy(acc, parts, dests, axis_name: str, spec,
+                     interpret: bool = False,
+                     chunk_elems: int = DEFAULT_CHUNK_ELEMS, weight=None):
+    """``acc + w·decode(permute(parts))`` as one fused Pallas op.
+
+    Drop-in replacement for the XLA seam
+    ``acc + codec.decode(tuple(lax.ppermute(p, axis, pairs) for p in
+    parts), like)`` inside :func:`..parallel.collectives._round_fn` —
+    the encoded wire ``parts`` (from ``WireCodec.encode``; the sender
+    multiply, fault masks and EF injection already applied upstream)
+    are remote-copied chunk by chunk to the rank this rank's row of
+    ``dests`` names, decoded in VMEM per ``spec`` (a
+    :class:`~..parallel.wire.DecodeSpec`), and accumulated into ``acc``.
+
+    ``weight`` is the receive-side axpy scalar; the column-stochastic
+    round bakes the mixing weight into the sender multiply, so the
+    default ``None`` (identity) is the production path.  Must be called
+    inside ``shard_map`` with ``axis_name`` bound; all ranks execute
+    the same program (the remote DMA is SPMD).
+    """
+    if spec is None:
+        raise ValueError("codec exposes no in-kernel decode spec; the "
+                         "caller must take the XLA ppermute path")
+    kind = spec.kind
+    if kind not in ("f32", "bf16", "int8"):
+        raise ValueError(f"unknown decode spec kind {kind!r}")
+    n = acc.size
+    block = spec.block if kind == "int8" else None
+    rows, c, nb = _chunk_layout(n, block, chunk_elems)
+
+    # this rank's destination from the static table, as an SMEM scalar
+    table = jnp.asarray(np.asarray(dests), jnp.int32)
+    dst = table[jax.lax.axis_index(axis_name)].reshape(1)
+
+    acc_flat = _pad_rows(acc.reshape(-1), nb * c).reshape(nb, c)
+    if kind == "int8":
+        q, scale = parts
+        q_chunks = _pad_rows(q, nb * rows).reshape(nb, rows, q.shape[1])
+        s_chunks = _pad_rows(scale, nb * rows).reshape(nb, rows)
+        parts_chunks = (q_chunks, s_chunks)
+    else:
+        (w,) = parts
+        parts_chunks = (_pad_rows(w.reshape(-1), nb * c).reshape(nb, c),)
+
+    out = _edge_axpy_call(kind, interpret, dst, acc_flat, parts_chunks)
+    out = out.reshape(-1)[:n].reshape(acc.shape)
+    if weight is not None:
+        out = acc + (out - acc) * jnp.asarray(weight, acc.dtype)
+    return out
+
+
+# -- CI selftest (scripts/gossipkernel.py) ----------------------------------
+
+
+def _selftest() -> int:
+    """Interpret-mode kernel acceptance on the world-8 virtual CPU mesh:
+    the fused kernel must match the XLA decode+axpy bit-for-bit on the
+    f32 passthrough and to f32 tolerance on int8, including a chunked
+    (multi-grid-step) payload with a ragged tail."""
+    import sys
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import wire
+    from ..parallel.mesh import GOSSIP_AXIS, make_gossip_mesh
+
+    world = 8
+    if jax.device_count() < world:
+        print(f"gossip-kernel selftest FAILED: needs {world} devices, "
+              f"have {jax.device_count()} (run via "
+              "scripts/gossipkernel.py)", file=sys.stderr)
+        return 1
+    failures: list[str] = []
+    mesh = make_gossip_mesh(world)
+    dests = np.asarray([(r + 1) % world for r in range(world)])
+    rng = np.random.default_rng(0)
+    # ragged: 3 chunks at chunk_elems=128 with a 44-element tail
+    n = 300
+    x = rng.normal(size=(world, n)).astype(np.float32)
+    codec = wire.Int8Codec(64)
+
+    def both_lanes(xr):
+        xr = xr.reshape(-1)
+        acc = xr * 0.25
+        pairs = [(s, int(dests[s])) for s in range(world)]
+        # f32 passthrough lane
+        k_f32 = gossip_edge_axpy(acc, (xr,), dests, GOSSIP_AXIS,
+                                 wire.F32.kernel_spec(), interpret=True,
+                                 chunk_elems=128)
+        x_f32 = acc + jax.lax.ppermute(xr, GOSSIP_AXIS, pairs)
+        # int8 lane (shared encode, in-kernel vs XLA decode)
+        parts = codec.encode(xr)
+        k_i8 = gossip_edge_axpy(acc, parts, dests, GOSSIP_AXIS,
+                                codec.kernel_spec(), interpret=True,
+                                chunk_elems=128)
+        x_i8 = acc + codec.decode(
+            tuple(jax.lax.ppermute(p, GOSSIP_AXIS, pairs)
+                  for p in parts), xr)
+        return tuple(t[None] for t in (k_f32, x_f32, k_i8, x_i8))
+
+    fn = jax.jit(jax.shard_map(both_lanes, mesh=mesh,
+                               in_specs=P(GOSSIP_AXIS),
+                               out_specs=(P(GOSSIP_AXIS),) * 4))
+    k_f32, x_f32, k_i8, x_i8 = map(np.asarray, fn(x))
+    if not np.array_equal(k_f32, x_f32):
+        failures.append(
+            f"f32 passthrough lane diverged from XLA ppermute "
+            f"(max |d| {np.abs(k_f32 - x_f32).max():.2e}); the fused "
+            "transport must be bit-identical")
+    d8 = np.abs(k_i8 - x_i8).max()
+    if d8 > 1e-6:
+        failures.append(
+            f"int8 in-kernel dequant drifted {d8:.2e} from the XLA "
+            "decode (same scales, same op order — should be aligned)")
+    # resolver contract: typed rejection instead of a Mosaic crash
+    try:
+        resolve_gossip_kernel("pallas", interpret=False)
+        if jax.default_backend() != "tpu":
+            failures.append("resolve_gossip_kernel('pallas') on a "
+                            "non-TPU backend did not raise")
+    except KernelBackendError:
+        pass
+    if resolve_gossip_kernel("auto", interpret=True) is None:
+        failures.append("auto+interpret must resolve to the kernel lane")
+    if resolve_gossip_kernel("xla") is not None:
+        failures.append("'xla' must resolve to the ppermute lane")
+
+    if failures:
+        for f in failures:
+            print(f"gossip-kernel selftest FAILED: {f}", file=sys.stderr)
+        return 1
+    print(f"gossip-kernel selftest: OK (world {world}, payload {n} over "
+          f"3 chunks: f32 lane bit-identical, int8 lane max |d| "
+          f"{d8:.1e}; pallas-on-cpu rejected with a typed error)")
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="gossipkernel",
+        description="Fused Pallas gossip kernel: CI selftest")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the interpret-mode kernel self-check")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    ap.error("choose --selftest")
+    return 2

@@ -47,6 +47,7 @@ from .flash_attention import (
     flash_attention_backward,
     flash_attention_forward,
 )
+from .gossip_kernel import resolve_use_pallas
 
 __all__ = ["ring_flash_attention"]
 
@@ -254,10 +255,12 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
       interpret: run the Pallas kernels through the interpreter
         (CPU tests of the real kernel path).
       use_pallas: force the kernel choice; default auto — Pallas on TPU
-        (or when ``interpret``), pure-JAX blockwise tick elsewhere.
+        (or when ``interpret``), pure-JAX blockwise tick elsewhere.  The
+        auto rule is the shared
+        :func:`~.gossip_kernel.resolve_use_pallas`, one convention for
+        every Pallas lane in ops/.
     """
-    if use_pallas is None:
-        use_pallas = interpret or jax.default_backend() == "tpu"
+    use_pallas = resolve_use_pallas(use_pallas, interpret)
     if block is None:
         from .flash_attention import default_block
 

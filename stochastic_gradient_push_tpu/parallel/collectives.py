@@ -110,8 +110,43 @@ def _resolve_codec(codec, comm_dtype):
     return codec
 
 
+def _edge_transport(acc, msg, parts, codec, dests, pairs, axis_name,
+                    kernel):
+    """One edge's wire for one leaf: accumulate the received (decoded)
+    contribution into ``acc``.
+
+    The single seam where the two transport lanes meet: the XLA lane
+    ppermutes each encoded part and decodes at the receiver; the Pallas
+    lane (``kernel`` — an :class:`~..ops.gossip_kernel.KernelLane`)
+    hands the same encoded parts to the fused remote-DMA kernel, which
+    decodes in VMEM and performs the mixing axpy in-place
+    (ops/gossip_kernel.py).  Everything upstream — the sender multiply,
+    fault masks, EF residual injection, ``codec.encode`` — is shared, so
+    the EF residual always telescopes against the same sent bytes and
+    the lanes stay bit-aligned.  A codec with no in-kernel decode spec
+    falls back to the XLA lane.
+    """
+    if kernel is not None:
+        from ..ops import gossip_kernel as gk
+
+        spec = (codec.kernel_spec() if codec is not None
+                else wire_mod.F32.kernel_spec())
+        if spec is not None:
+            return gk.gossip_edge_axpy(
+                acc, parts if codec is not None else (msg,), dests,
+                axis_name, spec, interpret=kernel.interpret,
+                chunk_elems=kernel.chunk_elems)
+    if codec is not None:
+        recv = codec.decode(tuple(lax.ppermute(p, axis_name, pairs)
+                                  for p in parts), msg)
+    else:
+        recv = lax.ppermute(msg, axis_name, pairs)
+    return acc + recv
+
+
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
-              comm_dtype=None, faults=None, codec=None, split=False):
+              comm_dtype=None, faults=None, codec=None, split=False,
+              kernel=None):
     """Build the mixing function for one static phase of the schedule.
 
     Returns ``mix(tree, tick, residual) -> (out, new_residual)``;
@@ -146,6 +181,12 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
     remains exactly mean-preserving under any fault plan).  NaN
     corruption poisons real payload leaves only; the push-sum weight
     lane stays finite so ps-weight telemetry survives the fault.
+
+    ``kernel`` (an :class:`~..ops.gossip_kernel.KernelLane`, or None for
+    the XLA ppermute lane) routes real payload leaves through the fused
+    Pallas transport (:func:`_edge_transport`): remote DMA + in-VMEM
+    decode + mixing axpy in one op.  Scalar leaves — the push-sum
+    weight — never enter the kernel.
     """
     lo_table = schedule.self_weight[phase_idx]
     edge_w = schedule.edge_weights[phase_idx]
@@ -203,12 +244,14 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                     msg = jnp.where(keep > 0, msg, jnp.zeros_like(msg))
                 if send_codec is not None and msg.size > 1:
                     parts = send_codec.encode(msg)
-                    recv = send_codec.decode(
-                        tuple(lax.ppermute(p, axis_name, pairs)
-                              for p in parts), msg)
+                    acc[j] = _edge_transport(acc[j], msg, parts,
+                                             send_codec, perms[i], pairs,
+                                             axis_name, kernel)
                     if res_in is not None:
                         # quantization error of what was attempted on the
-                        # wire (zero for a dropped edge: Q(0) == 0)
+                        # wire (zero for a dropped edge: Q(0) == 0) —
+                        # computed from the SAME encoded parts both
+                        # transport lanes ship
                         q_err = msg - send_codec.decode(parts, msg)
                         if inject:
                             # carry rule: when this rank did not put its
@@ -220,9 +263,14 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                             err[j] = q_err + r * (1.0 - attempt)
                         else:
                             err[j] = err[j] + q_err
+                elif msg.size > 1:
+                    acc[j] = _edge_transport(acc[j], msg, None, None,
+                                             perms[i], pairs, axis_name,
+                                             kernel)
                 else:
-                    recv = lax.ppermute(msg, axis_name, pairs)
-                acc[j] = acc[j] + recv
+                    # scalar (ps-weight) lane: exact f32 ppermute in BOTH
+                    # transport lanes — bit-identical by construction
+                    acc[j] = acc[j] + lax.ppermute(msg, axis_name, pairs)
             if keep is not None and faults.reabsorb:
                 # sender reabsorbs the undelivered weight: the effective
                 # column still sums to 1 (mass conservation).  In-place
@@ -241,7 +289,8 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
 
 
 def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
-                   axis_name: str, comm_dtype=None, codec=None):
+                   axis_name: str, comm_dtype=None, codec=None,
+                   kernel=None):
     """One compiled hierarchical round: leader ppermute, then the exact
     intra-slice average as ONE grouped ``psum`` over the slice sub-axis
     (ICI-local; the ``slice_size − 1`` rotate-permutations of the table
@@ -256,9 +305,13 @@ def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
     are the DCN ones.  The error-feedback residual likewise lives on
     the inter lane and stays rank-local (never psum-averaged: it is
     sender memory, not network mass).
+
+    The Pallas ``kernel`` lane likewise rides the delegate (inter) edge
+    phase only — the grouped intra-slice psum is a fused XLA collective
+    already and stays one.
     """
     inter = _round_fn(hsched.inter_schedule, round_idx, axis_name,
-                      comm_dtype, codec=codec)
+                      comm_dtype, codec=codec, kernel=kernel)
 
     def mix(tree, tick, residual):
         t, new_res = inter(tree, tick, residual)
@@ -281,14 +334,17 @@ def intra_average(tree, hsched: HierarchicalSchedule, axis_name: str):
 
 
 def _synth_round_fn(ssched: SynthesizedSchedule, phase_idx: int,
-                    axis_name: str, comm_dtype=None, codec=None):
+                    axis_name: str, comm_dtype=None, codec=None,
+                    kernel=None):
     """One compiled synthesized phase: an edge phase is one ``ppermute``
     round through the compact per-phase tables (full wire-codec path),
     a psum phase is ONE grouped ``lax.psum`` over the spec's equal rank
     blocks — numerically exactly the ``g − 1`` rotate-permutation
     matrix the verifier checks.  The error-feedback residual rides edge
     phases only and passes through psum phases untouched (an exact
-    collective has no quantization error to account)."""
+    collective has no quantization error to account).  The Pallas
+    ``kernel`` lane follows the same split: edge phases take the fused
+    transport, psum phases stay grouped ``lax.psum``."""
     if ssched.phase_kinds[phase_idx] == "psum":
         groups = [list(g) for g in ssched.phase_groups[phase_idx]]
         inv_g = 1.0 / len(groups[0])
@@ -302,12 +358,12 @@ def _synth_round_fn(ssched: SynthesizedSchedule, phase_idx: int,
 
         return mix
     return _round_fn(ssched.edge_phase_schedule(phase_idx), 0, axis_name,
-                     comm_dtype, codec=codec)
+                     comm_dtype, codec=codec, kernel=kernel)
 
 
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
                  comm_dtype=None, faults=None, tick=None, codec=None,
-                 ef_residual=None):
+                 ef_residual=None, kernel=None):
     """One synchronous gossip round over an arbitrary pytree.
 
     Computes ``lo * x + Σ_i ppermute(w_i * x, perm_i(phase))`` — the
@@ -337,16 +393,23 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
     ``ef_residual`` (a pytree mirroring ``tree``) enables error feedback
     with a lossy codec; the call then returns ``(mixed, new_residual)``
     instead of ``mixed`` (see the module docstring for the semantics).
+
+    ``kernel`` (an :class:`~..ops.gossip_kernel.KernelLane`; resolve the
+    CLI flag with :func:`~..ops.gossip_kernel.resolve_gossip_kernel`)
+    routes real payload leaves through the fused Pallas remote-DMA
+    transport instead of ``lax.ppermute`` + decode; None is the XLA
+    lane.  Numerics are lane-independent (pinned by the kernel parity
+    tests); scalar leaves ship the same exact ppermute either way.
     """
     mixed, new_res = _apply_round(tree, phase, schedule, axis_name,
                                   comm_dtype, faults, tick, codec,
-                                  ef_residual, split=False)
+                                  ef_residual, split=False, kernel=kernel)
     return mixed if ef_residual is None else (mixed, new_res)
 
 
 def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
                    comm_dtype=None, faults=None, tick=None, codec=None,
-                   ef_residual=None):
+                   ef_residual=None, kernel=None):
     """Launch half of the double-buffered overlap round.
 
     Issues round ``phase``'s ``ppermute`` NOW — called at the TOP of the
@@ -376,11 +439,13 @@ def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
       ICI-local psum stays synchronous — it cannot ride in flight).
 
     Returns ``(local, incoming)``, or ``(local, incoming, new_residual)``
-    when ``ef_residual`` is given.
+    when ``ef_residual`` is given.  ``kernel`` selects the fused Pallas
+    transport exactly as in :func:`gossip_round` — the launch half IS
+    the wire, so the lane choice lives here too.
     """
     out, new_res = _apply_round(tree, phase, schedule, axis_name,
                                 comm_dtype, faults, tick, codec,
-                                ef_residual, split=True)
+                                ef_residual, split=True, kernel=kernel)
     local, incoming = out
     if ef_residual is None:
         return local, incoming
@@ -388,7 +453,7 @@ def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
 
 
 def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
-                 tick, codec, ef_residual, split):
+                 tick, codec, ef_residual, split, kernel=None):
     """Shared dispatch of one (possibly split) gossip round: validation,
     per-phase branch construction, traced-phase ``lax.switch``."""
     if isinstance(schedule, HierarchicalSchedule) and faults is not None:
@@ -429,7 +494,7 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
         # psum); the traced phase index selects among them like any
         # flat rotation
         branches = [_synth_round_fn(schedule, p, axis_name, comm_dtype,
-                                    codec)
+                                    codec, kernel=kernel)
                     for p in range(schedule.num_phases)]
         idx = as_scalar(phase) % schedule.num_phases
         fault_tick = None
@@ -439,11 +504,13 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
             # overlap launch: the delegate ppermute only — the caller
             # runs intra_average when the share is consumed
             branches = [_round_fn(schedule.inter_schedule, q, axis_name,
-                                  comm_dtype, codec=codec, split=True)
+                                  comm_dtype, codec=codec, split=True,
+                                  kernel=kernel)
                         for q in range(rounds)]
         else:
             branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype,
-                                       codec) for q in range(rounds)]
+                                       codec, kernel=kernel)
+                        for q in range(rounds)]
         idx = as_scalar(phase) % rounds
         fault_tick = None
     else:
@@ -452,7 +519,7 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
         else:
             fault_tick = None
         branches = [_round_fn(schedule, p, axis_name, comm_dtype, faults,
-                              codec, split=split)
+                              codec, split=split, kernel=kernel)
                     for p in range(schedule.num_phases)]
         idx = as_scalar(phase) % schedule.num_phases
 
@@ -465,7 +532,7 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
 
 def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
                  axis_name: str, comm_dtype=None, faults=None, tick=None,
-                 codec=None, ef_residual=None):
+                 codec=None, ef_residual=None, kernel=None):
     """Push-sum round: jointly mixes parameters and the push-sum weight.
 
     The reference appends the scalar ps-weight to the flat payload only when
@@ -486,16 +553,17 @@ def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
     if ef_residual is None:
         return gossip_round(tree, phase, schedule, axis_name,
                             comm_dtype=comm_dtype, faults=faults,
-                            tick=tick, codec=codec)
+                            tick=tick, codec=codec, kernel=kernel)
     full_res = (ef_residual, jax.tree.map(jnp.zeros_like, ps_weight))
     (p, w), (new_res, _) = gossip_round(
         tree, phase, schedule, axis_name, comm_dtype=comm_dtype,
-        faults=faults, tick=tick, codec=codec, ef_residual=full_res)
+        faults=faults, tick=tick, codec=codec, ef_residual=full_res,
+        kernel=kernel)
     return p, w, new_res
 
 
 def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str,
-                  comm_dtype=None, codec=None):
+                  comm_dtype=None, codec=None, kernel=None):
     """Doubly-stochastic (D-PSGD) round.
 
     With uniform mixing on a regular graph the mixing matrix is doubly
@@ -508,7 +576,7 @@ def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str,
         raise ValueError("push-pull requires a regular schedule "
                          "(doubly-stochastic mixing)")
     return gossip_round(params, phase, schedule, axis_name,
-                        comm_dtype=comm_dtype, codec=codec)
+                        comm_dtype=comm_dtype, codec=codec, kernel=kernel)
 
 
 def mix_bilat(params, phase, pairing: np.ndarray, axis_name: str):

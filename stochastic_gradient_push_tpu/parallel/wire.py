@@ -42,16 +42,33 @@ every byte the gossip hot path puts on the wire goes through a codec.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 
 __all__ = ["WireCodec", "F32Codec", "BF16Codec", "Int8Codec",
-           "F32", "BF16", "WIRE_DTYPES", "DEFAULT_WIRE_BLOCK",
-           "INT8_SCALE_BYTES", "get_codec", "from_comm_dtype"]
+           "DecodeSpec", "F32", "BF16", "WIRE_DTYPES",
+           "DEFAULT_WIRE_BLOCK", "INT8_SCALE_BYTES", "get_codec",
+           "from_comm_dtype"]
 
 WIRE_DTYPES = ("f32", "bf16", "int8")
 DEFAULT_WIRE_BLOCK = 64
 # dtype of the per-block scale lane riding alongside the int8 payload
 INT8_SCALE_BYTES = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeSpec:
+    """In-kernel decode descriptor a codec exposes to the fused gossip
+    kernel (ops/gossip_kernel.py): enough static structure — the decode
+    kind and the int8 block — for the kernel to reconstruct
+    :meth:`WireCodec.decode` chunk-locally in VMEM, with the SAME
+    elementwise op order, so the kernel lane stays bit-aligned with the
+    XLA ppermute+decode lane.  A codec returning ``None`` (the base
+    default for unknown subclasses) keeps the collective layer on the
+    XLA path — the kernel never guesses a decode."""
+
+    kind: str                  # "f32" passthrough | "bf16" widen | "int8"
+    block: int | None = None   # int8 elements per f32 scale
 
 
 class WireCodec:
@@ -62,11 +79,18 @@ class WireCodec:
     ``encode`` must return a *tuple* of arrays; the collective layer
     ppermutes each part and hands the received tuple back to
     :meth:`decode` with the local leaf as the shape/dtype template (all
-    ranks hold identically shaped leaves under SPMD).
+    ranks hold identically shaped leaves under SPMD).  :meth:`kernel_spec`
+    optionally describes the decode to the fused gossip kernel; the base
+    ``None`` means "no in-kernel decode known" and pins the XLA path.
     """
 
     name = "f32"
     lossy = False
+
+    def kernel_spec(self) -> DecodeSpec | None:
+        """Static decode descriptor for ops/gossip_kernel.py (None =
+        this codec has no in-kernel decode; use the XLA path)."""
+        return None
 
     def encode(self, msg):
         return (msg,)
@@ -95,6 +119,9 @@ class WireCodec:
 class F32Codec(WireCodec):
     """Explicit name for the identity codec (``--wire_dtype f32``)."""
 
+    def kernel_spec(self) -> DecodeSpec:
+        return DecodeSpec("f32")
+
 
 class BF16Codec(WireCodec):
     """Truncate payloads to bfloat16 on the wire (half the bytes,
@@ -116,6 +143,9 @@ class BF16Codec(WireCodec):
     def element_bytes(self, n: int, itemsize: int = 4) -> int:
         del itemsize
         return n * 2
+
+    def kernel_spec(self) -> DecodeSpec:
+        return DecodeSpec("bf16")
 
 
 class Int8Codec(WireCodec):
@@ -168,6 +198,9 @@ class Int8Codec(WireCodec):
     def element_bytes(self, n: int, itemsize: int = 4) -> int:
         del itemsize
         return n + INT8_SCALE_BYTES * int(math.ceil(n / self.block))
+
+    def kernel_spec(self) -> DecodeSpec:
+        return DecodeSpec("int8", block=self.block)
 
     def to_dict(self) -> dict:
         return {"dtype": "int8", "block": self.block}

@@ -18,7 +18,13 @@ world-8 virtual CPU mesh:
    bound) and its de-biased mean lands at the same optimum;
 3. **pricing** — the modeled encoded bytes
    (telemetry.encoded_payload_bytes through CommModel) match an
-   independent hand count, and the int8 payload is >= 3.5x below f32.
+   independent hand count, and the int8 payload is >= 3.5x below f32;
+4. **kernel lane** — the SAME int8+EF chaos round re-run through the
+   fused Pallas gossip kernel (ops/gossip_kernel.py, interpret mode)
+   must reproduce the XLA path: telescoped mean preserved to the same
+   bound, params within f32 tolerance, and the push-sum weight
+   trajectory BIT-IDENTICAL round by round (the scalar lane never
+   enters the kernel, so any divergence is a transport bug).
 
 Everything runs on CPU in seconds; the wrapper script forces the
 virtual 8-device platform before jax loads.
@@ -69,33 +75,46 @@ def _selftest() -> int:
     # -- 1. chaos round: int8 + EF + a dropped edge ------------------------
     sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
     masks = parse_fault_spec(CHAOS_SPEC).build_masks(sched)
-    alg = sgp(sched, GOSSIP_AXIS, faults=masks, wire=codec,
-              error_feedback=True)
-
-    def gossip_step(params, gstate):
-        params, gstate = alg.post_step(params, gstate)
-        sig = health_signals(params, None, gstate.ps_weight, GOSSIP_AXIS,
-                             ef_residual=gstate.ef_residual)
-        return params, gstate, jax.tree.map(lambda a: a[None], sig)
-
-    step = jax.jit(jax.shard_map(
-        gossip_step, mesh=mesh, in_specs=(P(GOSSIP_AXIS),) * 2,
-        out_specs=(P(GOSSIP_AXIS),) * 3))
-
     rng = np.random.default_rng(0)
-    params = rng.normal(size=(WORLD, 128)).astype(np.float32)
-    x0_mean = params.mean(0)
-    gstate = jax.tree.map(
-        lambda a: np.broadcast_to(np.asarray(a),
-                                  (WORLD,) + np.shape(a)).copy(),
-        alg.init(jnp.zeros((128,), jnp.float32)))
+    x0 = rng.normal(size=(WORLD, 128)).astype(np.float32)
+    x0_mean = x0.mean(0)
 
-    monitor = HealthMonitor(health_every=1, residual_floor=1e9, log=None)
-    report = None
-    for t in range(CHAOS_ROUNDS):
-        params, gstate, sig = jax.block_until_ready(step(params, gstate))
-        sig = {k: float(np.asarray(v)[0]) for k, v in sig.items()}
-        report = monitor.observe(t, sig)
+    def run_chaos(kernel):
+        """The chaos loop on one transport lane; returns the final
+        (params, gstate, last sig, last report, ps-weight trajectory)."""
+        alg = sgp(sched, GOSSIP_AXIS, faults=masks, wire=codec,
+                  error_feedback=True, gossip_kernel=kernel)
+
+        def gossip_step(params, gstate):
+            params, gstate = alg.post_step(params, gstate)
+            sig = health_signals(params, None, gstate.ps_weight,
+                                 GOSSIP_AXIS,
+                                 ef_residual=gstate.ef_residual)
+            return params, gstate, jax.tree.map(lambda a: a[None], sig)
+
+        step = jax.jit(jax.shard_map(
+            gossip_step, mesh=mesh, in_specs=(P(GOSSIP_AXIS),) * 2,
+            out_specs=(P(GOSSIP_AXIS),) * 3))
+
+        params = x0.copy()
+        gstate = jax.tree.map(
+            lambda a: np.broadcast_to(np.asarray(a),
+                                      (WORLD,) + np.shape(a)).copy(),
+            alg.init(jnp.zeros((128,), jnp.float32)))
+        monitor = HealthMonitor(health_every=1, residual_floor=1e9,
+                                log=None)
+        report = None
+        ps_traj = []
+        for t in range(CHAOS_ROUNDS):
+            params, gstate, sig = jax.block_until_ready(
+                step(params, gstate))
+            ps_traj.append(np.asarray(gstate.ps_weight).copy())
+            sig = {k: float(np.asarray(v)[0]) for k, v in sig.items()}
+            report = monitor.observe(t, sig)
+        return (np.asarray(params), gstate, sig, report,
+                np.stack(ps_traj))
+
+    params, gstate, sig, report, ps_traj = run_chaos(None)
 
     res = np.asarray(gstate.ef_residual)
     # telescoping identity: delivered mass + pending residuals == exact
@@ -179,6 +198,30 @@ def _selftest() -> int:
     check(model.to_dict()["wire_dtype"] == "int8"
           and model.to_dict()["error_feedback"],
           "CommModel snapshot does not stamp the wire codec")
+    check(model.to_dict().get("gossip_kernel") == "xla",
+          "CommModel snapshot does not stamp the transport lane")
+
+    # -- 4. kernel lane: the same chaos round through the fused kernel -----
+    from ..ops.gossip_kernel import KernelLane
+
+    k_params, k_gstate, _, _, k_ps_traj = run_chaos(
+        KernelLane(interpret=True))
+    check(np.array_equal(ps_traj, k_ps_traj),
+          "kernel-lane ps-weight trajectory diverged from the XLA path "
+          f"(max |d| {np.abs(ps_traj - k_ps_traj).max():.2e}); the "
+          "scalar lane must be bit-identical — it never enters the "
+          "kernel")
+    k_res = np.asarray(k_gstate.ef_residual)
+    k_drift = np.abs((k_params.sum(0) + k_res.sum(0)) / WORLD
+                     - x0_mean).max()
+    check(k_drift < 1e-5,
+          f"kernel-lane telescoped mean drifted {k_drift:.2e} under "
+          "int8+EF with a dropped edge (in-kernel decode broke the "
+          "residual accounting)")
+    d_params = np.abs(k_params - params).max()
+    check(d_params < 1e-5,
+          f"kernel-lane params diverged {d_params:.2e} from the XLA "
+          "path after the chaos round (beyond f32 tolerance)")
 
     if failures:
         for f in failures:
@@ -188,7 +231,8 @@ def _selftest() -> int:
           f"drift {drift_tel:.2e} telescoped / {drift_raw:.2e} raw, "
           f"ef_rms {ef_rms:.2e} in band; parity spread {i8_spread:.2e} "
           f"vs f32 {f32_spread:.2e}; payload {exact}->{enc} B = "
-          f"{exact / enc:.2f}x)")
+          f"{exact / enc:.2f}x; kernel lane: ps-weight bit-identical, "
+          f"params |d| {d_params:.1e}, telescoped drift {k_drift:.2e})")
     return 0
 
 

@@ -24,12 +24,12 @@ import os
 import time
 
 from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
-from .gossip_sgd import (add_fleet_flags, add_staleness_flag,
-                         add_synth_flags, add_wire_flags,
-                         reject_push_sum_wire_knobs,
-                         resolve_fleet_flags, resolve_staleness_flag,
-                         resolve_wire_flags, synth_plan_config,
-                         wire_plan_config)
+from .gossip_sgd import (add_fleet_flags, add_kernel_flag,
+                         add_staleness_flag, add_synth_flags,
+                         add_wire_flags, reject_push_sum_wire_knobs,
+                         resolve_fleet_flags, resolve_kernel_flag,
+                         resolve_staleness_flag, resolve_wire_flags,
+                         synth_plan_config, wire_plan_config)
 
 __all__ = ["main", "build_parser"]
 
@@ -104,6 +104,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gossip_every", default=1, type=int,
                    help="gossip on every k-th step (communication thinning)")
     add_wire_flags(p)
+    add_kernel_flag(p)
     add_fleet_flags(p)
     # optimization
     p.add_argument("--lr", default=0.5, type=float)
@@ -320,6 +321,7 @@ def main(argv=None):
     # resilience/mixing flag validation (same error text as gossip_sgd,
     # fail before any device work)
     resolve_wire_flags(args)
+    resolve_kernel_flag(args)
     resolve_staleness_flag(args, sb(args.overlap))
     args.mixing_alpha = _parse_mixing_alpha(args.mixing_alpha)
     if args.mixing_alpha is not None and (
@@ -592,12 +594,14 @@ def main(argv=None):
                       gossip_every=args.gossip_every,
                       wire=get_codec(args.wire_dtype, args.wire_block),
                       error_feedback=bool(args.error_feedback),
-                      global_avg_every=gae, faults=faults)
+                      global_avg_every=gae, faults=faults,
+                      gossip_kernel=args.gossip_kernel)
         else:
             reject_push_sum_wire_knobs(args)
             alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
                         staleness=max(1, args.staleness),
-                        global_avg_every=gae, faults=faults)
+                        global_avg_every=gae, faults=faults,
+                        gossip_kernel=args.gossip_kernel)
 
     tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
              nesterov=sb(args.nesterov))
@@ -715,7 +719,9 @@ def main(argv=None):
                 interconnect=interconnect, codec=codec,
                 error_feedback=bool(args.error_feedback),
                 overlap=getattr(alg, "overlap", False),
-                staleness=getattr(alg, "staleness", 1))
+                staleness=getattr(alg, "staleness", 1),
+                gossip_kernel=getattr(
+                    getattr(alg, "gossip_kernel", None), "name", "xla"))
         rt.attach_comm(comm_model)
     if rt.enabled:
         run_meta = {

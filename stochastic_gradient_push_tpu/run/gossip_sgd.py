@@ -90,6 +90,36 @@ def resolve_wire_flags(args) -> None:
     args.error_feedback = ef
 
 
+def add_kernel_flag(p: argparse.ArgumentParser) -> None:
+    """The gossip transport-kernel flag, shared by both run CLIs."""
+    from ..ops.gossip_kernel import GOSSIP_KERNELS
+
+    p.add_argument("--gossip_kernel", default="auto",
+                   choices=list(GOSSIP_KERNELS),
+                   help="gossip transport lane (ops/gossip_kernel.py): "
+                        "'pallas' fuses the edge exchange into one "
+                        "remote-DMA kernel (async copy + in-VMEM wire "
+                        "decode + mixing axpy; TPU only), 'xla' is the "
+                        "ppermute + decode fallback, 'auto' picks "
+                        "pallas on TPU and xla elsewhere.  Numerics are "
+                        "lane-independent (CI bit-compares them); the "
+                        "push-sum weight lane ships exact f32 either "
+                        "way")
+
+
+def resolve_kernel_flag(args) -> None:
+    """Validate --gossip_kernel at parse time (shared by both CLIs):
+    'pallas' on a backend that cannot lower the Mosaic kernel fails
+    HERE with the resolver's typed error instead of at first step."""
+    from ..ops.gossip_kernel import KernelBackendError, \
+        resolve_gossip_kernel
+
+    try:
+        resolve_gossip_kernel(args.gossip_kernel)
+    except KernelBackendError as e:
+        raise SystemExit(f"--gossip_kernel pallas: {e}")
+
+
 def add_synth_flags(p: argparse.ArgumentParser) -> None:
     """Schedule-synthesizer budget knobs, shared by both run CLIs: only
     meaningful with ``--topology synth`` (planner/synthesize.py)."""
@@ -342,6 +372,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_accum", default=1, type=int,
                    help="microbatches accumulated per optimizer step")
     add_wire_flags(p)
+    add_kernel_flag(p)
     p.add_argument("--warmup", default="False", type=str)
     p.add_argument("--seed", default=47, type=int)
     p.add_argument("--resume", default="False", type=str)
@@ -450,6 +481,7 @@ def parse_config(argv=None):
         raise SystemExit("peers_per_itr_schedule must include epoch 0")
     all_reduce = _str_bool(args.all_reduce)
     resolve_wire_flags(args)
+    resolve_kernel_flag(args)
     resolve_staleness_flag(args, _str_bool(args.overlap))
     if all_reduce or not _str_bool(args.push_sum):
         # fail at parse time with the same text as the LM CLI's branches
@@ -534,6 +566,7 @@ def parse_config(argv=None):
         wire_dtype=args.wire_dtype,
         wire_block=args.wire_block,
         error_feedback=bool(args.error_feedback),
+        gossip_kernel=args.gossip_kernel,
         per_rank_csv=_str_bool(args.per_rank_csv),
         heartbeat_timeout=args.heartbeat_timeout,
         global_avg_every=args.global_avg_every or 0,

@@ -173,6 +173,12 @@ class CommModel:
     # volume — so these fields only stamp the mode into snapshots
     overlap: bool = False
     staleness: int = 1
+    # transport-lane provenance (ops/gossip_kernel.py): "pallas" = the
+    # fused remote-DMA kernel, "xla" = ppermute + decode.  Like overlap,
+    # the lane re-times the wire without re-pricing it — bytes on the
+    # interconnect are identical by construction — so this only stamps
+    # which kernel moved them (obsreport and the bench artifacts read it)
+    gossip_kernel: str = "xla"
     wire_bytes_per_phase: tuple[int, ...] = ()
     ici_bytes_per_phase: tuple[int, ...] = ()
     dcn_bytes_per_phase: tuple[int, ...] = ()
@@ -188,7 +194,8 @@ class CommModel:
                       interconnect=None, codec=None,
                       error_feedback: bool = False,
                       overlap: bool = False,
-                      staleness: int = 1) -> "CommModel":
+                      staleness: int = 1,
+                      gossip_kernel: str = "xla") -> "CommModel":
         """Model a push-sum/D-PSGD run over ``schedule``.
 
         ``payload_bytes`` must already be the ENCODED wire payload
@@ -294,6 +301,7 @@ class CommModel:
                        error_feedback=bool(error_feedback),
                        overlap=bool(overlap),
                        staleness=max(1, int(staleness)),
+                       gossip_kernel=str(gossip_kernel),
                        wire_bytes_per_phase=tuple(wire_l),
                        ici_bytes_per_phase=tuple(ici_l),
                        dcn_bytes_per_phase=tuple(dcn_l),
@@ -329,6 +337,7 @@ class CommModel:
                        error_feedback=bool(error_feedback),
                        overlap=bool(overlap),
                        staleness=max(1, int(staleness)),
+                       gossip_kernel=str(gossip_kernel),
                        wire_bytes_per_phase=tuple(wire_l),
                        ici_bytes_per_phase=tuple(ici_l),
                        dcn_bytes_per_phase=tuple(dcn_l),
@@ -366,6 +375,7 @@ class CommModel:
                    error_feedback=bool(error_feedback),
                    overlap=bool(overlap),
                    staleness=max(1, int(staleness)),
+                   gossip_kernel=str(gossip_kernel),
                    wire_bytes_per_phase=tuple(wire_l),
                    ici_bytes_per_phase=tuple(ici_l),
                    dcn_bytes_per_phase=tuple(dcn_l),
@@ -474,6 +484,7 @@ class CommModel:
                 "error_feedback": self.error_feedback,
                 "overlap": self.overlap,
                 "staleness": self.staleness,
+                "gossip_kernel": self.gossip_kernel,
                 "ici_bytes_per_phase": list(self.ici_bytes_per_phase),
                 "dcn_bytes_per_phase": list(self.dcn_bytes_per_phase)}
 

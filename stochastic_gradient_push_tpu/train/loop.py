@@ -87,6 +87,11 @@ class TrainerConfig:
     # DEPRECATED alias for wire_dtype="bf16" (the pre-codec knob); kept
     # so existing launch scripts and library callers keep working
     gossip_comm_dtype: str | None = None
+    # gossip transport lane (ops/gossip_kernel.py): "pallas" fuses each
+    # edge exchange into one remote-DMA kernel (in-VMEM wire decode +
+    # mixing axpy; TPU only — a typed KernelBackendError elsewhere),
+    # "xla" is the ppermute+decode fallback, "auto" picks pallas on TPU
+    gossip_kernel: str = "auto"
     bilat: bool = False                       # AD-PSGD family
     # AD-PSGD with REAL wall-clock asynchrony: the compiled step carries
     # no collective; a host thread averages bilaterally off the hot path
@@ -436,13 +441,15 @@ class Trainer:
                        error_feedback=cfg.error_feedback,
                        staleness=staleness,
                        global_avg_every=cfg.global_avg_every,
-                       faults=faults)
+                       faults=faults,
+                       gossip_kernel=cfg.gossip_kernel)
         if cfg.gossip_every != 1:
             raise ValueError("gossip_every is a push-sum knob")
         return dpsgd(schedule, axis, overlap=cfg.overlap,
                      staleness=staleness,
                      global_avg_every=cfg.global_avg_every,
-                     faults=faults)
+                     faults=faults,
+                     gossip_kernel=cfg.gossip_kernel)
 
     def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):
         """Compiled step for a peers-per-itr value; each distinct
@@ -511,7 +518,9 @@ class Trainer:
                 interconnect=interconnect, codec=codec,
                 error_feedback=cfg.error_feedback,
                 overlap=getattr(alg, "overlap", False),
-                staleness=getattr(alg, "staleness", 1))
+                staleness=getattr(alg, "staleness", 1),
+                gossip_kernel=getattr(
+                    getattr(alg, "gossip_kernel", None), "name", "xla"))
         self.telemetry.attach_comm(model)
         meta = {
             "world": self.gossip_world, "algorithm": alg_name,

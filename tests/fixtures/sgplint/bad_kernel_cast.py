@@ -1,0 +1,38 @@
+"""SGPL010 at the fused-kernel wire boundary (ops/gossip_kernel.py).
+
+The gossip wire has exactly one encode path — parallel/wire.py's
+WireCodec family — whichever transport moves the bytes.  The fused
+Pallas kernel (``gossip_edge_axpy``) ships its ``parts`` tuple exactly
+like a ppermute payload, so an inline ``.astype(...)`` in its acc or
+parts arguments bypasses pricing and error feedback the same way an
+inline ppermute cast does.  The kernel's own IN-KERNEL decode lives in
+ops/gossip_kernel.py, which is whitelisted alongside parallel/wire.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from stochastic_gradient_push_tpu.ops.gossip_kernel import gossip_edge_axpy
+
+DESTS = [1, 0]
+
+
+@jax.jit
+def leaky_kernel_send(x, spec):
+    # inline down-cast on the kernel's wire parts: the bytes shipped no
+    # longer match what the codec priced or the EF residual accounted
+    return gossip_edge_axpy(x, (x.astype(jnp.bfloat16),), DESTS,  # EXPECT: SGPL010
+                            "gossip", spec)
+
+
+@jax.jit
+def leaky_kernel_acc(x, spec):
+    # a cast hidden in the accumulator expression is the same leak
+    return gossip_edge_axpy(x.astype(jnp.float32) * 0.5, (x,), DESTS,  # EXPECT: SGPL010
+                            "gossip", spec)
+
+
+@jax.jit
+def clean_kernel_send(x, parts, spec):
+    # encoded upstream by a WireCodec: the payload arrives cast-free
+    return gossip_edge_axpy(x, parts, DESTS, "gossip", spec)
