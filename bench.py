@@ -395,13 +395,15 @@ def _resolve_bench_kernel():
     the gossip transport lane for both --gossip-vs-ar and
     --overlap-vs-sync.  An explicit ``pallas`` off-TPU runs through the
     Pallas interpreter (correctness lane, honest-but-slow ms); ``auto``
-    keeps the production rule (pallas on TPU, xla elsewhere)."""
+    is the resolver rule (pallas on TPU, xla elsewhere).  The default
+    matches production's conservative ``xla`` until the kernel's
+    live-TPU capture lands."""
     import jax
 
     from stochastic_gradient_push_tpu.ops.gossip_kernel import (
         resolve_gossip_kernel)
 
-    flag = os.environ.get("BENCH_GVA_KERNEL", "auto")
+    flag = os.environ.get("BENCH_GVA_KERNEL", "xla")
     interpret = flag == "pallas" and jax.default_backend() != "tpu"
     lane = resolve_gossip_kernel(flag, interpret=interpret)
     return lane, ("pallas" if lane is not None else "xla")
@@ -644,6 +646,18 @@ def run_overlap_vs_sync() -> dict:
     reps = max(1, int(os.environ.get("BENCH_OVS_REPS", "3")))
     staleness = max(1, int(os.environ.get("BENCH_OVS_STALENESS", "2")))
     kernel_lane, kernel_name = _resolve_bench_kernel()
+    if kernel_lane is not None:
+        # overlap rounds force the xla lane at the collective seam, so
+        # honoring a pallas request here would time sync-on-pallas
+        # against overlap-on-xla — a cross-lane comparison that no
+        # longer measures overlap at all.  Hold the transport constant:
+        # both timed modes run xla (the pallas lane's own step time is
+        # --gossip-vs-ar's measurement)
+        print("overlap-vs-sync: BENCH_GVA_KERNEL requested the pallas "
+              "lane, but overlap rounds always run xla — timing both "
+              "modes on xla to keep the comparison lane-pure",
+              file=sys.stderr)
+        kernel_lane, kernel_name = None, "xla"
     classes = 10
 
     mesh = make_gossip_mesh(world)
@@ -730,9 +744,12 @@ def run_overlap_vs_sync() -> dict:
     sync_bytes = CommModel.from_schedule(
         schedule, payload, gossip_kernel=kernel_name).totals(
         steps, start=warmup)
+    # overlap rounds force the xla lane at the collective seam, so the
+    # overlap comm model stamps the lane that ACTUALLY ran — not the
+    # requested one (same rule as transport_kernel_name in the trainers)
     over_bytes = CommModel.from_schedule(
         schedule, payload, overlap=True, staleness=staleness,
-        gossip_kernel=kernel_name).totals(steps, start=warmup)
+        gossip_kernel="xla").totals(steps, start=warmup)
 
     out = {
         "metric": "overlap_vs_sync_step_ms",
@@ -742,8 +759,12 @@ def run_overlap_vs_sync() -> dict:
         "speedup_vs_sync": round(sync_ms / overlap_ms, 3)
         if overlap_ms else None,
         "staleness": staleness,
-        # the gossip transport lane both modes ran (BENCH_GVA_KERNEL);
-        # bytes are lane-independent, only measured ms may move
+        # the gossip transport lane BOTH timed modes ran.  Overlap
+        # rounds always resolve to xla at the collective seam (the
+        # fused op cannot hide behind compute), so a pallas request is
+        # forced to xla for the sync mode too — the speedup must
+        # compare like against like.  Bytes are lane-independent either
+        # way; only measured ms may move
         "kernel": kernel_name,
         "world": world,
         "batch": batch,
@@ -778,9 +799,12 @@ def run_overlap_vs_sync() -> dict:
                        "measurement.  The same caveat covers the kernel "
                        "lane: BENCH_r04/r05 headline values are cached "
                        "on-chip captures, and the pallas lane's "
-                       "measured-ms win needs a live-TPU capture — on "
-                       "cpu the kernel runs through the Pallas "
-                       "interpreter (correctness, not speed)")
+                       "measured-ms win needs a live-TPU capture (until "
+                       "it lands, pallas is opt-in everywhere — the "
+                       "production default is xla, and overlap rounds "
+                       "resolve to xla regardless) — on cpu the kernel "
+                       "runs through the Pallas interpreter "
+                       "(correctness, not speed)")
     out_path = os.environ.get(
         "BENCH_OVS_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -290,6 +290,24 @@ class PushSumGossip(GossipAlgorithm):
             gossip_kernel = resolve_gossip_kernel(gossip_kernel)
         self.gossip_kernel = gossip_kernel
 
+    @property
+    def transport_kernel_name(self) -> str:
+        """The transport lane the wire ACTUALLY runs, for telemetry.
+        Two configurations resolve a configured kernel lane back to
+        ``"xla"``: overlap rounds (the fused kernel starts and waits
+        its DMA inside one op, so the collective layer forces the async
+        start/done pair that can hide behind compute — see
+        ``collectives._apply_round``), and a lossy codec with no
+        in-kernel decode spec (``kernel_spec() is None`` pins the XLA
+        path at ``collectives._edge_transport``; a lossless codec
+        resolves to the exact-f32 wire, which the kernel does carry)."""
+        if self.gossip_kernel is None or self.overlap:
+            return "xla"
+        if (self.wire is not None and self.wire.lossy
+                and self.wire.kernel_spec() is None):
+            return "xla"
+        return self.gossip_kernel.name
+
     # -- helpers -----------------------------------------------------------
 
     def _zeros_like_params(self, params: Params):

@@ -13,7 +13,16 @@ and a separate axpy folds it into the accumulator.  Here one
   part (the int8 scale side-lane is its own part) straight from the
   sender's HBM into the destination rank's receive buffer, signalled by
   per-chunk send/recv DMA semaphores (the SNIPPETS.md [2] right-permute
-  pattern, generalized to an arbitrary static destination table);
+  pattern, generalized to an arbitrary static destination table).  On
+  grid step 0 — before the first RDMA — every rank runs an entry
+  barrier with its destination AND its source (the barrier semaphore
+  ``collective_id`` exists for): a fast sender must not write into the
+  receiver's HBM receive buffers while the receiver has not yet entered
+  the kernel and that scratch memory still belongs to a previous op.
+  The barrier is emitted in compiled (Mosaic) mode only: the Pallas
+  interpreter discharges each remote copy synchronously across the mesh
+  axis, so no such race exists there (and its discharge rules do not
+  implement remote semaphore signals);
 * **in-receive decode** — the received chunk is DMA'd into VMEM and
   decoded there: f32 passthrough, bf16 widen, int8 per-block dequant
   against the scale side-lane (``parallel/wire.py`` owns the encode;
@@ -57,8 +66,9 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["KernelBackendError", "KernelLane", "GOSSIP_KERNELS",
-           "DEFAULT_CHUNK_ELEMS", "resolve_use_pallas",
-           "resolve_gossip_kernel", "gossip_edge_axpy", "main"]
+           "DEFAULT_CHUNK_ELEMS", "COLLECTIVE_ID_SLOTS",
+           "resolve_use_pallas", "resolve_gossip_kernel",
+           "gossip_edge_axpy", "main"]
 
 # CLI vocabulary for --gossip_kernel
 GOSSIP_KERNELS = ("auto", "pallas", "xla")
@@ -71,6 +81,14 @@ DEFAULT_CHUNK_ELEMS = 64 * 1024
 # ceiling on chunks per call (bounds the per-chunk DMA semaphore
 # arrays); larger payloads get proportionally larger chunks
 _MAX_CHUNKS = 256
+
+# barrier-semaphore id pool the collective layer cycles per leaf slot:
+# Mosaic keys barrier/collective state by collective_id, so two
+# pallas_calls that could execute concurrently must not share one.
+# Same-leaf calls are chained by their accumulator data dependency;
+# distinct leaves get distinct ids from this pool (collectives.py
+# passes collective_id = leaf_slot % COLLECTIVE_ID_SLOTS)
+COLLECTIVE_ID_SLOTS = 16
 
 
 class KernelBackendError(RuntimeError):
@@ -167,15 +185,15 @@ def _pad_rows(a, rows: int):
 # -- the kernel -------------------------------------------------------------
 
 
-def _edge_axpy_kernel(kind: str, nparts: int, out_dtype,
+def _edge_axpy_kernel(kind: str, nparts: int, out_dtype, barrier: bool,
                       dst_ref, acc_ref, *refs):
     """One grid step: remote-copy this chunk of every wire part to the
     destination rank, pull the received chunk into VMEM, decode, and
     accumulate into the output block.
 
-    Ref layout (after the SMEM destination scalar and the pipelined
-    accumulator block): ``refs = (*part_refs, out_ref, *recv_bufs,
-    *vmem_bufs, *send_sems, *recv_sems, copy_sem)``.
+    Ref layout (after the SMEM ``[dst, src]`` rank pair and the
+    pipelined accumulator block): ``refs = (*part_refs, out_ref,
+    *recv_bufs, *vmem_bufs, *send_sems, *recv_sems, copy_sem)``.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -191,6 +209,28 @@ def _edge_axpy_kernel(kind: str, nparts: int, out_dtype,
 
     k = pl.program_id(0)
     dst = dst_ref[0]
+
+    if barrier:
+        # entry barrier (compiled mode only — the interpreter's
+        # discharge is synchronous and cannot signal remote
+        # semaphores): before the FIRST remote copy, handshake with
+        # the rank we write into (dst) and the rank that writes into
+        # us (src, the permutation's inverse at this rank), so no
+        # sender DMAs into recv_bufs before its receiver has entered
+        # the kernel and owns that scratch memory.  Each rank receives
+        # exactly two signals (from ITS src and dst) and waits the
+        # semaphore back down to zero, per the Mosaic barrier contract.
+        @pl.when(k == 0)
+        def _entry_barrier():
+            src = dst_ref[1]
+            bsem = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                bsem, inc=1, device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(
+                bsem, inc=1, device_id=src,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_wait(bsem, 2)
 
     # transport: chunk k of every part rides one remote DMA to the
     # destination; waiting the descriptor waits BOTH our send drain and
@@ -230,8 +270,8 @@ def _edge_axpy_kernel(kind: str, nparts: int, out_dtype,
     out_ref[...] = acc_ref[...] + dec
 
 
-def _edge_axpy_call(kind: str, interpret: bool, dst, acc_chunks,
-                    parts_chunks):
+def _edge_axpy_call(kind: str, interpret: bool, collective_id: int, dst,
+                    acc_chunks, parts_chunks):
     """Build and invoke the pallas_call for one edge/leaf payload whose
     chunking is already laid out (acc ``[NB, C]``, each part
     ``[NB, ...]`` — the shapes alone carry the layout)."""
@@ -240,8 +280,11 @@ def _edge_axpy_call(kind: str, interpret: bool, dst, acc_chunks,
 
     nb, c = acc_chunks.shape
     nparts = len(parts_chunks)
+    # the entry barrier only lowers through Mosaic; the interpreter's
+    # discharge rules run each remote copy synchronously (raceless) and
+    # do not implement remote semaphore signals
     kernel = functools.partial(_edge_axpy_kernel, kind, nparts,
-                               acc_chunks.dtype)
+                               acc_chunks.dtype, not interpret)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(acc_chunks.shape, acc_chunks.dtype),
@@ -259,16 +302,25 @@ def _edge_axpy_call(kind: str, interpret: bool, dst, acc_chunks,
             [pltpu.SemaphoreType.DMA((nb,))] * (2 * nparts) +
             [pltpu.SemaphoreType.DMA(())]),
         # the out block keeps the call live through DCE; collective_id
-        # coordinates the remote-DMA buffer addresses across the SPMD
-        # programs on a real mesh
-        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+        # keys the entry-barrier semaphore and coordinates the
+        # remote-DMA buffer addresses across the SPMD programs on a
+        # real mesh.  Two calls that could execute concurrently must
+        # not share an id (Mosaic keys barrier state by it): the
+        # collective layer cycles ids per leaf slot
+        # (COLLECTIVE_ID_SLOTS) — same-leaf calls are already ordered
+        # by their accumulator data dependency, and TPU's single
+        # compute stream executes custom calls sequentially in schedule
+        # order, which backstops any id reuse across the pool boundary
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=collective_id),
         interpret=interpret,
     )(dst, acc_chunks, *parts_chunks)
 
 
 def gossip_edge_axpy(acc, parts, dests, axis_name: str, spec,
                      interpret: bool = False,
-                     chunk_elems: int = DEFAULT_CHUNK_ELEMS, weight=None):
+                     chunk_elems: int = DEFAULT_CHUNK_ELEMS, weight=None,
+                     collective_id: int = 0):
     """``acc + w·decode(permute(parts))`` as one fused Pallas op.
 
     Drop-in replacement for the XLA seam
@@ -285,6 +337,10 @@ def gossip_edge_axpy(acc, parts, dests, axis_name: str, spec,
     default ``None`` (identity) is the production path.  Must be called
     inside ``shard_map`` with ``axis_name`` bound; all ranks execute
     the same program (the remote DMA is SPMD).
+
+    ``collective_id`` keys the kernel's entry-barrier semaphore; call
+    sites that could execute concurrently must pass distinct ids (the
+    collective layer cycles ``leaf_slot % COLLECTIVE_ID_SLOTS``).
     """
     if spec is None:
         raise ValueError("codec exposes no in-kernel decode spec; the "
@@ -296,9 +352,21 @@ def gossip_edge_axpy(acc, parts, dests, axis_name: str, spec,
     block = spec.block if kind == "int8" else None
     rows, c, nb = _chunk_layout(n, block, chunk_elems)
 
-    # this rank's destination from the static table, as an SMEM scalar
-    table = jnp.asarray(np.asarray(dests), jnp.int32)
-    dst = table[jax.lax.axis_index(axis_name)].reshape(1)
+    # this rank's destination AND source from the static table, as an
+    # SMEM [dst, src] pair: the entry barrier handshakes with both the
+    # rank we write into and the rank that writes into us.  The source
+    # is the permutation's inverse at this rank — which only exists
+    # because the table is a bijection (SGPV101), so check it here
+    # rather than ship garbage into the barrier
+    table = np.asarray(dests, dtype=np.int32)
+    if not np.array_equal(np.sort(table), np.arange(table.size)):
+        raise ValueError(
+            "dests must be a permutation of the axis ranks (every rank "
+            f"receives exactly one stream); got {table.tolist()}")
+    inv = np.empty_like(table)
+    inv[table] = np.arange(table.size, dtype=np.int32)
+    both = jnp.asarray(np.stack([table, inv], axis=1), jnp.int32)
+    dst = both[jax.lax.axis_index(axis_name)]
 
     acc_flat = _pad_rows(acc.reshape(-1), nb * c).reshape(nb, c)
     if kind == "int8":
@@ -310,7 +378,8 @@ def gossip_edge_axpy(acc, parts, dests, axis_name: str, spec,
         (w,) = parts
         parts_chunks = (_pad_rows(w.reshape(-1), nb * c).reshape(nb, c),)
 
-    out = _edge_axpy_call(kind, interpret, dst, acc_flat, parts_chunks)
+    out = _edge_axpy_call(kind, interpret, int(collective_id), dst,
+                          acc_flat, parts_chunks)
     out = out.reshape(-1)[:n].reshape(acc.shape)
     if weight is not None:
         out = acc + (out - acc) * jnp.asarray(weight, acc.dtype)

@@ -111,7 +111,7 @@ def _resolve_codec(codec, comm_dtype):
 
 
 def _edge_transport(acc, msg, parts, codec, dests, pairs, axis_name,
-                    kernel):
+                    kernel, leaf_slot=0):
     """One edge's wire for one leaf: accumulate the received (decoded)
     contribution into ``acc``.
 
@@ -125,6 +125,11 @@ def _edge_transport(acc, msg, parts, codec, dests, pairs, axis_name,
     the EF residual always telescopes against the same sent bytes and
     the lanes stay bit-aligned.  A codec with no in-kernel decode spec
     falls back to the XLA lane.
+
+    ``leaf_slot`` (the leaf's flatten position) derives the kernel's
+    barrier ``collective_id``: same-leaf calls are ordered by their
+    accumulator data dependency, so distinct leaves — the only calls
+    that could execute concurrently — cycle distinct ids.
     """
     if kernel is not None:
         from ..ops import gossip_kernel as gk
@@ -135,7 +140,8 @@ def _edge_transport(acc, msg, parts, codec, dests, pairs, axis_name,
             return gk.gossip_edge_axpy(
                 acc, parts if codec is not None else (msg,), dests,
                 axis_name, spec, interpret=kernel.interpret,
-                chunk_elems=kernel.chunk_elems)
+                chunk_elems=kernel.chunk_elems,
+                collective_id=leaf_slot % gk.COLLECTIVE_ID_SLOTS)
     if codec is not None:
         recv = codec.decode(tuple(lax.ppermute(p, axis_name, pairs)
                                   for p in parts), msg)
@@ -246,7 +252,8 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                     parts = send_codec.encode(msg)
                     acc[j] = _edge_transport(acc[j], msg, parts,
                                              send_codec, perms[i], pairs,
-                                             axis_name, kernel)
+                                             axis_name, kernel,
+                                             leaf_slot=j)
                     if res_in is not None:
                         # quantization error of what was attempted on the
                         # wire (zero for a dropped edge: Q(0) == 0) —
@@ -266,7 +273,7 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                 elif msg.size > 1:
                     acc[j] = _edge_transport(acc[j], msg, None, None,
                                              perms[i], pairs, axis_name,
-                                             kernel)
+                                             kernel, leaf_slot=j)
                 else:
                     # scalar (ps-weight) lane: exact f32 ppermute in BOTH
                     # transport lanes — bit-identical by construction
@@ -439,9 +446,13 @@ def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
       ICI-local psum stays synchronous — it cannot ride in flight).
 
     Returns ``(local, incoming)``, or ``(local, incoming, new_residual)``
-    when ``ef_residual`` is given.  ``kernel`` selects the fused Pallas
-    transport exactly as in :func:`gossip_round` — the launch half IS
-    the wire, so the lane choice lives here too.
+    when ``ef_residual`` is given.  ``kernel`` is accepted for interface
+    parity with :func:`gossip_round` but overlap rounds always resolve
+    to the XLA ppermute lane: the fused kernel starts and waits its
+    remote DMA inside one op, which would serialize the transport this
+    launch exists to hide — XLA's async collective-permute start/done
+    pair is what actually overlaps with the step's compute (numerics
+    are lane-independent, so the round is unchanged).
     """
     out, new_res = _apply_round(tree, phase, schedule, axis_name,
                                 comm_dtype, faults, tick, codec,
@@ -456,6 +467,16 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
                  tick, codec, ef_residual, split, kernel=None):
     """Shared dispatch of one (possibly split) gossip round: validation,
     per-phase branch construction, traced-phase ``lax.switch``."""
+    if split and kernel is not None:
+        # overlap launches force the XLA ppermute lane: the fused
+        # Pallas kernel starts AND waits its remote DMA inside one op,
+        # so routed through the launch half it would serialize the very
+        # transport the overlap schedule exists to hide behind the
+        # step's compute.  XLA's async collective-permute start/done
+        # pair is what actually rides behind the forward/backward; the
+        # kernel lane stays a sync-round transport until it is split
+        # into separate start/wait calls (ROADMAP carried item)
+        kernel = None
     if isinstance(schedule, HierarchicalSchedule) and faults is not None:
         # static configuration error: reject before any axis
         # introspection so the message survives outside a mesh context

@@ -720,8 +720,8 @@ def main(argv=None):
                 error_feedback=bool(args.error_feedback),
                 overlap=getattr(alg, "overlap", False),
                 staleness=getattr(alg, "staleness", 1),
-                gossip_kernel=getattr(
-                    getattr(alg, "gossip_kernel", None), "name", "xla"))
+                gossip_kernel=getattr(alg, "transport_kernel_name",
+                                      "xla"))
         rt.attach_comm(comm_model)
     if rt.enabled:
         run_meta = {

@@ -94,17 +94,21 @@ def add_kernel_flag(p: argparse.ArgumentParser) -> None:
     """The gossip transport-kernel flag, shared by both run CLIs."""
     from ..ops.gossip_kernel import GOSSIP_KERNELS
 
-    p.add_argument("--gossip_kernel", default="auto",
+    p.add_argument("--gossip_kernel", default="xla",
                    choices=list(GOSSIP_KERNELS),
                    help="gossip transport lane (ops/gossip_kernel.py): "
                         "'pallas' fuses the edge exchange into one "
                         "remote-DMA kernel (async copy + in-VMEM wire "
-                        "decode + mixing axpy; TPU only), 'xla' is the "
-                        "ppermute + decode fallback, 'auto' picks "
-                        "pallas on TPU and xla elsewhere.  Numerics are "
-                        "lane-independent (CI bit-compares them); the "
-                        "push-sum weight lane ships exact f32 either "
-                        "way")
+                        "decode + mixing axpy; TPU only), 'auto' picks "
+                        "pallas on TPU and xla elsewhere.  Default "
+                        "'xla' (ppermute + decode, always available): "
+                        "the kernel is parity-pinned in CI through the "
+                        "Pallas interpreter but awaits a live-TPU "
+                        "capture — opt in with pallas/auto.  Numerics "
+                        "are lane-independent (CI bit-compares them); "
+                        "the push-sum weight lane ships exact f32 "
+                        "either way, and overlap rounds run xla "
+                        "regardless")
 
 
 def resolve_kernel_flag(args) -> None:

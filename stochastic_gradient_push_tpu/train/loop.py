@@ -90,8 +90,12 @@ class TrainerConfig:
     # gossip transport lane (ops/gossip_kernel.py): "pallas" fuses each
     # edge exchange into one remote-DMA kernel (in-VMEM wire decode +
     # mixing axpy; TPU only — a typed KernelBackendError elsewhere),
-    # "xla" is the ppermute+decode fallback, "auto" picks pallas on TPU
-    gossip_kernel: str = "auto"
+    # "auto" picks pallas on TPU.  Default "xla" (ppermute+decode): the
+    # kernel is parity-pinned through the Pallas interpreter but has no
+    # live-TPU capture yet — opt in explicitly until that lands, then
+    # flip this to "auto" (ROADMAP carried item).  Overlap rounds run
+    # "xla" regardless (the fused op cannot hide behind compute)
+    gossip_kernel: str = "xla"
     bilat: bool = False                       # AD-PSGD family
     # AD-PSGD with REAL wall-clock asynchrony: the compiled step carries
     # no collective; a host thread averages bilaterally off the hot path
@@ -519,8 +523,8 @@ class Trainer:
                 error_feedback=cfg.error_feedback,
                 overlap=getattr(alg, "overlap", False),
                 staleness=getattr(alg, "staleness", 1),
-                gossip_kernel=getattr(
-                    getattr(alg, "gossip_kernel", None), "name", "xla"))
+                gossip_kernel=getattr(alg, "transport_kernel_name",
+                                      "xla"))
         self.telemetry.attach_comm(model)
         meta = {
             "world": self.gossip_world, "algorithm": alg_name,

@@ -104,6 +104,40 @@ class TestResolvers:
         assert sgp(sched, GOSSIP_AXIS,
                    gossip_kernel=lane).gossip_kernel is lane
 
+    def test_overlap_resolves_to_xla_lane(self):
+        # the fused kernel starts and waits its DMA inside one op, so
+        # overlap rounds force the XLA ppermute lane (the only
+        # transport whose async start/done pair can hide behind
+        # compute); telemetry must stamp what actually runs
+        sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
+        lane = KernelLane(interpret=True)
+        sync_alg = sgp(sched, GOSSIP_AXIS, gossip_kernel=lane)
+        over_alg = sgp(sched, GOSSIP_AXIS, gossip_kernel=lane,
+                       overlap=True, staleness=2)
+        assert sync_alg.transport_kernel_name == "pallas"
+        assert over_alg.transport_kernel_name == "xla"
+        # the configured lane itself is preserved for introspection
+        assert over_alg.gossip_kernel is lane
+        assert sgp(sched, GOSSIP_AXIS).transport_kernel_name == "xla"
+
+    def test_specless_codec_resolves_to_xla_lane(self):
+        # a lossy codec with no in-kernel decode spec pins the XLA path
+        # at _edge_transport — telemetry must stamp what actually runs,
+        # not the requested lane
+        class Opaque(wire.WireCodec):
+            name = "opaque"
+            lossy = True
+
+        sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
+        lane = KernelLane(interpret=True)
+        alg = sgp(sched, GOSSIP_AXIS, gossip_kernel=lane, wire=Opaque())
+        assert alg.transport_kernel_name == "xla"
+        # a lossy codec WITH a spec (and the lossless exact wire, which
+        # the kernel carries as the f32 passthrough) keep the lane
+        assert sgp(sched, GOSSIP_AXIS, gossip_kernel=lane,
+                   wire=wire.Int8Codec(64)).transport_kernel_name \
+            == "pallas"
+
 
 class TestDecodeSpecs:
     def test_codecs_expose_specs(self):
@@ -134,14 +168,16 @@ class TestFlagPlumbing:
     def test_trainer_config_default(self):
         from stochastic_gradient_push_tpu.train.loop import TrainerConfig
 
-        assert TrainerConfig().gossip_kernel == "auto"
+        # conservative default until the kernel's live-TPU capture
+        # lands: pallas/auto are explicit opt-ins
+        assert TrainerConfig().gossip_kernel == "xla"
 
     def test_cli_default_and_rejection(self):
         from stochastic_gradient_push_tpu.run.gossip_sgd import (
             parse_config)
 
         cfg, args = parse_config(["--dataset", "synthetic"])
-        assert cfg.gossip_kernel == "auto"
+        assert cfg.gossip_kernel == "xla"
         if jax.default_backend() != "tpu":
             with pytest.raises(SystemExit, match="TPU backend"):
                 parse_config(["--dataset", "synthetic",
@@ -155,7 +191,7 @@ class TestFlagPlumbing:
             build_parser)
 
         args = build_parser().parse_args([])
-        assert args.gossip_kernel == "auto"
+        assert args.gossip_kernel == "xla"
 
     def test_comm_model_stamps_the_lane(self):
         from stochastic_gradient_push_tpu.telemetry import CommModel
@@ -226,6 +262,54 @@ def test_edge_axpy_matches_ppermute_decode(n, chunk):
                 err_msg=f"codec {name}, n={n}, chunk={chunk}")
 
 
+def test_compiled_mode_kernel_carries_the_entry_barrier():
+    """The compiled (non-interpret) kernel must run the inter-device
+    entry barrier before its first remote copy — signal dst AND src on
+    the collective_id-keyed barrier semaphore, wait both back down.
+    Mosaic lowering needs a real TPU, but the kernel body is traced at
+    pallas_call time, so abstract eval catches a broken barrier (wrong
+    primitive signature, mismatched SMEM spec) here: trace the
+    interpret=False path and pin the barrier ops in the jaxpr.  The
+    interpret path must stay barrier-free (jax's discharge rules are
+    synchronous and cannot signal remote semaphores)."""
+    mesh = make_gossip_mesh(WORLD)
+    dests = np.asarray([(r + 1) % WORLD for r in range(WORLD)])
+    codec = wire.Int8Codec(64)
+
+    def f(interpret):
+        def inner(xr):
+            xr = xr.reshape(-1)
+            return gossip_edge_axpy(
+                xr * 0.25, codec.encode(xr), dests, GOSSIP_AXIS,
+                codec.kernel_spec(), interpret=interpret,
+                chunk_elems=128, collective_id=3)[None]
+        return inner
+
+    x = np.zeros((WORLD, 300), np.float32)
+    traced = jax.make_jaxpr(jax.shard_map(
+        f(False), mesh=mesh, in_specs=P(GOSSIP_AXIS),
+        out_specs=P(GOSSIP_AXIS)))(x)
+    s = str(traced)
+    for op in ("get_barrier_semaphore", "semaphore_signal",
+               "semaphore_wait"):
+        assert op in s, f"compiled-mode kernel jaxpr lost {op}"
+    interp = str(jax.make_jaxpr(jax.shard_map(
+        f(True), mesh=mesh, in_specs=P(GOSSIP_AXIS),
+        out_specs=P(GOSSIP_AXIS)))(x))
+    assert "get_barrier_semaphore" not in interp, (
+        "interpret-mode kernel must not emit the barrier (remote "
+        "semaphore signals have no discharge rule)")
+
+
+def test_dests_must_be_a_permutation():
+    # the barrier handshakes with the permutation's inverse at this
+    # rank, which only exists for a bijection — reject garbage early
+    with pytest.raises(ValueError, match="permutation"):
+        gossip_edge_axpy(jnp.zeros(4), (jnp.zeros(4),), [1, 1],
+                         GOSSIP_AXIS, wire.F32.kernel_spec(),
+                         interpret=True)
+
+
 def _run_rounds(schedule, kernel, codec=None, ef=False, faults=None,
                 thin=1, overlap=False, staleness=1, leaf=96):
     """ROUNDS gossip steps of one configured PushSumGossip on one
@@ -259,6 +343,10 @@ def test_parity_sweep_kernel_vs_xla():
     drop fault, thinning} × {sync, overlap staleness 2}, kernel lane vs
     XLA lane.  ps-weight trajectories bit-identical; params within f32
     tolerance (FMA fusion on the fallback lane is the only slack).
+    The overlap rows pin the forced resolution to the XLA lane (the
+    fused op cannot hide behind compute, so overlap launches drop the
+    kernel at the collective seam) — the flag must still compose
+    cleanly with overlap and stay exact.
 
     One test on purpose: the sweep serializes its world-8 compiled
     programs (PR-8 deadlock note) and pairs each config's two lanes
