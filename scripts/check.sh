@@ -3,9 +3,15 @@
 # planner self-check, then tier-1 tests.  This is the command CI runs and
 # the command to run before pushing; all stages are CPU-only.
 #
-# The sgplint stage also emits the full spectral-gap grid as a JSON
-# artifact (artifacts/gap_report.json) so CI can diff mixing behavior
-# across PRs — a topology edit that silently moves a gap shows up as
+# The sgplint stage sweeps the package plus scripts/ and tests/
+# (fixtures excluded) through all three engines — per-module AST lint,
+# whole-program SPMD-hazard analysis over the call-graph closure, and
+# the semantic schedule verifier — with the baseline ratchet (stale
+# grandfathered entries fail the gate).  It also emits the full
+# spectral-gap grid plus the call-graph summary as one JSON artifact
+# (artifacts/gap_report.json) so CI can diff mixing behavior and
+# traced-closure drift across PRs — a topology edit that silently moves
+# a gap, or a refactor that silently untraces a helper, shows up as
 # artifact drift even when no rule fires.
 #
 # Usage: scripts/check.sh [extra pytest args...]
@@ -13,7 +19,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== sgplint (AST lint + schedule verifier) =="
+echo "== sgplint (AST lint + SPMD-hazard analysis + schedule verifier) =="
 python scripts/sgplint.py --check --report-json artifacts/gap_report.json
 
 echo

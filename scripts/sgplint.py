@@ -4,12 +4,17 @@
 Usage:
     python scripts/sgplint.py --check             # full gate (CI mode)
     python scripts/sgplint.py --files a.py b.py   # pre-commit mode
-    python scripts/sgplint.py --update-baseline
+    python scripts/sgplint.py --update-baseline   # deterministic rewrite
     python scripts/sgplint.py --report            # spectral-gap report
+    python scripts/sgplint.py --report-json PATH  # gap grid + call graph
     python scripts/sgplint.py --rules             # rule catalog
+    python scripts/sgplint.py --rules-md PATH     # regenerate the docs
+    python scripts/sgplint.py --check --no-cache  # bypass artifacts/ cache
 
-Runs on CPU in seconds; no TPU required.  See the "Analysis & invariants"
-section of ARCHITECTURE.md for the rule catalog.
+Runs on CPU in seconds; no TPU required.  The full gate sweeps the
+package plus scripts/ and tests/ (fixtures excluded) through all three
+engines and fails on any new finding or stale baseline entry.  See
+docs/sgplint_rules.md (generated) for the rule catalog.
 """
 
 import os

@@ -476,6 +476,7 @@ class PushSumGossip(GossipAlgorithm):
                 return collectives.intra_average(op, self.schedule,
                                                  self.axis_name)
 
+            # sgplint: disable=SGPL011 (fired is rank-uniform: step counter + static config)
             params, ps_weight = jax.lax.cond(
                 fired, intra_branch, lambda op: op, (params, ps_weight))
         empty = (self._zeros_like_params(in_params),

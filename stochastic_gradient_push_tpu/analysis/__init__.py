@@ -1,6 +1,6 @@
 """sgplint — static analysis for gossip/TPU correctness invariants.
 
-Two engines, one finding vocabulary:
+Three engines, one finding vocabulary:
 
 * :mod:`.astlint` (Engine 1) walks the package source and flags JAX/TPU
   footguns that the type system cannot see — collective calls whose
@@ -13,38 +13,56 @@ Two engines, one finding vocabulary:
   ``ppermute`` table is a bijection, every mixing matrix is
   column-stochastic, every full rotation cycle is an ergodic contraction
   (positive spectral gap), and every bilateral pairing is an involution.
+* :mod:`.spmd` (Engine 3) runs interprocedural SPMD-hazard rules over
+  the whole-program call graph (:mod:`.callgraph` — a full transitive
+  fixpoint closure over the import graph): collective-sequence
+  divergence across ``lax.cond``/``lax.switch`` branches (SGPL011),
+  unsynchronized host dispatch loops of compiled collectives — the PR 8
+  deadlock shape (SGPL012) — and Pallas DMA/semaphore hygiene in
+  ``pallas_call`` kernels (SGPL013).
 
-``scripts/sgplint.py`` is the CLI; ``tests/test_sgplint.py`` runs both
+``scripts/sgplint.py`` is the CLI; ``tests/test_sgplint.py`` runs all
 engines in tier-1 on CPU.  Findings carry ``file:line``, a rule id from
 :data:`.findings.RULES`, and a one-line fix hint; a checked-in baseline
 (``sgplint.baseline.json``) grandfathers old findings with zero tolerance
-for new ones.
+for new ones — and the ratchet fails on *stale* entries, so the baseline
+monotonically shrinks.  Engine 1 + 3 results are memoized per content
+hash under ``artifacts/`` (:mod:`.cache`), keeping the pre-commit hook
+sub-second despite the whole-program closure.
 """
 
-from .findings import Finding, RULES, load_baseline, save_baseline
-from .astlint import lint_paths, lint_file
-from .verifier import (
-    verify_package,
-    verify_module,
-    verify_schedule,
-    verify_pairing,
-    spectral_gap,
-    spectral_gap_cache_clear,
-    spectral_gap_cache_info,
-    spectral_gap_cache_limit,
-    schedule_fingerprint,
-    GapEntry,
-    is_unsupported_config,
-    DEFAULT_WORLD_SIZES,
-)
+from .findings import (Finding, RULES, load_baseline, save_baseline,
+                       render_rules_markdown, stale_baseline_entries)
+from .astlint import lint_paths, lint_file, lint_program
+
+# Engine 2 exports resolve lazily (PEP 562): the verifier executes the
+# topology layer and therefore imports jax — the pure-AST engines (and
+# the pre-commit --files path) must not pay for that.
+_VERIFIER_EXPORTS = frozenset({
+    "verify_package", "verify_module", "verify_schedule", "verify_pairing",
+    "spectral_gap", "spectral_gap_cache_clear", "spectral_gap_cache_info",
+    "spectral_gap_cache_limit", "schedule_fingerprint", "GapEntry",
+    "is_unsupported_config", "DEFAULT_WORLD_SIZES",
+})
+
+
+def __getattr__(name):
+    if name in _VERIFIER_EXPORTS:
+        from . import verifier
+        return getattr(verifier, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "Finding",
     "RULES",
     "load_baseline",
     "save_baseline",
+    "stale_baseline_entries",
+    "render_rules_markdown",
     "lint_paths",
     "lint_file",
+    "lint_program",
     "verify_package",
     "verify_module",
     "verify_schedule",

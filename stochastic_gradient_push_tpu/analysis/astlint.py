@@ -284,6 +284,31 @@ def _collect_traced(mod: _Module,
     return traced
 
 
+def _module_axes(mod: _Module) -> set[str]:
+    """One module's mesh-axis declarations (the per-file contribution
+    to the vocabulary; cached per content hash via ModuleInterface)."""
+    axes: set[str] = set()
+    for name, val in mod.constants.items():
+        if name.endswith("_AXIS"):
+            axes.add(val)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func) or ""
+        if not (callee.endswith("Mesh") or "mesh" in callee.lower()):
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for el in arg.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        axes.add(el.value)
+                    elif isinstance(el, ast.Name) \
+                            and el.id in mod.constants:
+                        axes.add(mod.constants[el.id])
+    return axes
+
+
 def collect_axis_vocabulary(paths) -> set[str]:
     """Mesh axis names declared anywhere under ``paths``.
 
@@ -299,25 +324,7 @@ def collect_axis_vocabulary(paths) -> set[str]:
             tree = ast.parse(source, filename=path)
         except (OSError, SyntaxError):
             continue
-        mod = _Module(path, source, tree)
-        for name, val in mod.constants.items():
-            if name.endswith("_AXIS"):
-                axes.add(val)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            callee = _dotted(node.func) or ""
-            if not (callee.endswith("Mesh") or "mesh" in callee.lower()):
-                continue
-            for arg in list(node.args) + [k.value for k in node.keywords]:
-                if isinstance(arg, (ast.Tuple, ast.List)):
-                    for el in arg.elts:
-                        if isinstance(el, ast.Constant) \
-                                and isinstance(el.value, str):
-                            axes.add(el.value)
-                        elif isinstance(el, ast.Name) \
-                                and el.id in mod.constants:
-                            axes.add(mod.constants[el.id])
+        axes |= _module_axes(_Module(path, source, tree))
     return axes
 
 
@@ -669,64 +676,11 @@ def _resolve_import(entry_path: str, level: int, module: str,
     if not module:
         return None
     tail = os.path.join(*module.split("."))
+    mod_suffix = os.sep + tail + ".py"
+    pkg_suffix = os.sep + os.path.join(tail, "__init__.py")
     hits = [p for p in known
-            if p.endswith(os.sep + tail + ".py")
-            or p.endswith(os.sep + os.path.join(tail, "__init__.py"))]
+            if p.endswith(mod_suffix) or p.endswith(pkg_suffix)]
     return hits[0] if len(hits) == 1 else None
-
-
-def _cross_module_seeds(mods: dict[str, _Module]) -> dict[str, set[str]]:
-    """One-import-hop closure: for every module, the function names its
-    siblings' traced code calls through an import.
-
-    Handles ``from .sib import helper; helper(x)`` (name call) and
-    ``from . import sib; sib.helper(x)`` (module-attribute call), plus
-    helpers handed straight to a tracing wrapper (``jax.jit(helper)``).
-    Seeds come only from each module's *own* traced set, so tracedness
-    propagates exactly one hop.
-    """
-    known = set(mods)
-    seeds: dict[str, set[str]] = {p: set() for p in known}
-    for apath, mod in mods.items():
-        name_imports: dict[str, tuple[str, str]] = {}
-        mod_imports: dict[str, str] = {}
-        for level, module, orig, alias in mod.from_imports:
-            sub = f"{module}.{orig}" if module else orig
-            target = _resolve_import(apath, level, sub, known)
-            if target is not None:       # `orig` IS a module
-                mod_imports[alias] = target
-                continue
-            target = _resolve_import(apath, level, module, known)
-            if target is not None and target != apath:
-                name_imports[alias] = (target, orig)
-        if not (name_imports or mod_imports):
-            continue
-
-        def mark(call: ast.Call) -> None:
-            f = call.func
-            if isinstance(f, ast.Name) and f.id in name_imports:
-                target, orig = name_imports[f.id]
-                seeds[target].add(orig)
-            elif isinstance(f, ast.Attribute) \
-                    and isinstance(f.value, ast.Name) \
-                    and f.value.id in mod_imports:
-                seeds[mod_imports[f.value.id]].add(f.attr)
-
-        for fn in _collect_traced(mod):
-            for n in ast.walk(fn):
-                if isinstance(n, ast.Call):
-                    mark(n)
-        # an imported helper handed to a tracing wrapper anywhere in the
-        # module (jax.jit(helper), shard_map(helper, ...)) is traced too
-        for n in ast.walk(mod.tree):
-            if isinstance(n, ast.Call):
-                fn_name, args = _func_name_args(mod, n)
-                if fn_name in _TRACING_WRAPPERS and args \
-                        and isinstance(args[0], ast.Name) \
-                        and args[0].id in name_imports:
-                    target, orig = name_imports[args[0].id]
-                    seeds[target].add(orig)
-    return seeds
 
 
 def _lint_mod(mod: _Module, axes: set[str], relpath: str,
@@ -736,33 +690,114 @@ def _lint_mod(mod: _Module, axes: set[str], relpath: str,
     return sorted(linter.findings)
 
 
+def build_program(paths, cache=None):
+    """Parse / cache-load every ``.py`` under ``paths`` into module
+    interfaces and compose the whole-program call graph.
+
+    Returns ``(sources, graph)`` where ``sources`` maps abspath to
+    ``(mod_or_None, content_sha)`` — ``mod`` is the parsed
+    :class:`_Module` for cache misses, ``None`` when the interface came
+    from the cache (the file is re-parsed lazily only if Engine 1 also
+    misses).
+    """
+    from .callgraph import build_graph, extract_interface
+
+    sources: dict[str, tuple] = {}
+    interfaces: dict = {}
+    for f in iter_py_files(paths):
+        apath = os.path.abspath(f)
+        if apath in interfaces:
+            continue
+        raw = open(f, "rb").read()
+        if cache is not None:
+            from .cache import content_sha
+            sha = content_sha(raw)
+            iface = cache.get_interface(apath, sha)
+        else:
+            sha, iface = None, None
+        if iface is None:
+            source = raw.decode()
+            tree = ast.parse(source, filename=f)
+            mod = _Module(f, source, tree)
+            iface = extract_interface(mod)
+            iface.path = apath
+            if cache is not None:
+                cache.put_interface(apath, sha, iface)
+            sources[apath] = (mod, sha)
+        else:
+            sources[apath] = (None, sha)
+        interfaces[apath] = iface
+    return sources, build_graph(interfaces)
+
+
+def lint_program(paths, axes: set[str] | None = None,
+                 relto: str | None = None, cache=None):
+    """Whole-program lint: Engine 1 per module under the **full
+    transitive fixpoint** traced closure, plus Engine 3's
+    interprocedural SPMD-hazard rules over the call graph.
+
+    Returns ``(findings, graph)`` so callers can emit the call-graph
+    artifact.  ``cache`` (a :class:`~.cache.LintCache`) memoizes both
+    interface extraction and Engine 1 findings per content hash.
+    """
+    from .cache import env_sha
+    from .spmd import analyze_program
+
+    sources, graph = build_program(paths, cache=cache)
+    if axes is None:
+        axes = set()
+        for iface in graph.interfaces.values():
+            axes.update(iface.axes)
+    findings: list[Finding] = []
+    for apath in graph.interfaces:
+        mod, sha = sources[apath]
+        rel = os.path.relpath(apath, relto) if relto else apath
+        seeds = graph.traced_seeds(apath)
+        cached = None
+        if cache is not None and sha is not None:
+            env = env_sha(seeds, axes, rel)
+            cached = cache.get_findings(apath, sha, env)
+        if cached is None:
+            if mod is None:  # interface was cached but findings were not
+                source = open(apath).read()
+                mod = _Module(apath, source,
+                              ast.parse(source, filename=apath))
+            cached = _lint_mod(mod, axes, rel, seeds)
+            if cache is not None and sha is not None:
+                cache.put_findings(apath, sha, env, cached)
+        findings.extend(cached)
+    findings.extend(analyze_program(graph, relto=relto))
+    if cache is not None:
+        cache.save()
+    return sorted(findings), graph
+
+
 def lint_file(path: str, axes: set[str], relto: str | None = None
               ) -> list[Finding]:
-    """Lint one file in isolation (no cross-module closure — use
+    """Lint one file in isolation: Engine 1 plus Engine 3 over the
+    singleton call graph (no cross-module closure — use
     :func:`lint_paths` for that)."""
+    from .callgraph import build_graph, extract_interface
+    from .spmd import analyze_program
+
     source = open(path).read()
     tree = ast.parse(source, filename=path)
     rel = os.path.relpath(path, relto) if relto else path
-    return _lint_mod(_Module(path, source, tree), axes, rel)
+    mod = _Module(path, source, tree)
+    apath = os.path.abspath(path)
+    iface = extract_interface(mod)
+    iface.path = apath
+    graph = build_graph({apath: iface})
+    findings = _lint_mod(mod, axes, rel, graph.traced_seeds(apath))
+    findings.extend(analyze_program(graph, relto=relto))
+    return sorted(findings)
 
 
 def lint_paths(paths, axes: set[str] | None = None,
-               relto: str | None = None) -> list[Finding]:
+               relto: str | None = None, cache=None) -> list[Finding]:
     """Lint every ``.py`` under ``paths``; axis vocabulary defaults to
     what the same paths declare.  Linting a file *set* enables the
-    cross-module call-graph closure: helpers one import hop from traced
-    code are linted as traced in their own module."""
-    if axes is None:
-        axes = collect_axis_vocabulary(paths)
-    mods: dict[str, _Module] = {}
-    for f in iter_py_files(paths):
-        source = open(f).read()
-        tree = ast.parse(source, filename=f)
-        mods[os.path.abspath(f)] = _Module(f, source, tree)
-    seeds = _cross_module_seeds(mods)
-    findings: list[Finding] = []
-    for apath, mod in mods.items():
-        rel = os.path.relpath(mod.path, relto) if relto else mod.path
-        findings.extend(_lint_mod(mod, axes, rel,
-                                  frozenset(seeds.get(apath, ()))))
-    return sorted(findings)
+    whole-program call-graph closure: tracedness propagates along call
+    edges across any number of import hops (full transitive fixpoint),
+    and Engine 3's interprocedural rules run over the resulting graph."""
+    return lint_program(paths, axes=axes, relto=relto, cache=cache)[0]

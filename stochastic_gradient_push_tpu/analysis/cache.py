@@ -1,0 +1,128 @@
+"""Content-hash lint cache (keeps the pre-commit hook sub-second).
+
+The whole-program closure means every sgplint invocation — even
+``--files`` on one staged file — must see every module's interface.
+Re-parsing ~160 files per commit would cost seconds, so both expensive
+per-file products are memoized under ``artifacts/`` (gitignored):
+
+* the :class:`~.callgraph.ModuleInterface`, keyed on the file's content
+  hash — a cache hit skips ``ast.parse`` entirely;
+* Engine 1's findings, keyed on (content hash, traced-seed set, axis
+  vocabulary) — the environment key matters because cross-module seeds
+  and the axis vocabulary change a file's findings without changing the
+  file.
+
+Engine 3 is recomputed from interfaces every run (dictionary work, no
+AST).  The cache is best-effort: unreadable or version-skewed files are
+discarded wholesale, and ``--no-cache`` bypasses it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+from .callgraph import ModuleInterface
+from .findings import Finding
+
+__all__ = ["LintCache", "content_sha", "CACHE_SCHEMA"]
+
+# bump whenever interface extraction or any engine's rules change shape
+CACHE_SCHEMA = 1
+
+DEFAULT_CACHE_PATH = os.path.join("artifacts", "sgplint_cache.json")
+
+
+def content_sha(source: bytes) -> str:
+    return hashlib.sha256(source).hexdigest()[:24]
+
+
+def env_sha(seeds, axes, relpath: str) -> str:
+    blob = json.dumps([sorted(seeds), sorted(axes), relpath])
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+class LintCache:
+    """``{path: {sha, interface, engine1: {env_sha: [findings]}}}``."""
+
+    def __init__(self, path: str | None, enabled: bool = True):
+        self.path = path
+        self.enabled = enabled and path is not None
+        self._data: dict = {}
+        self._dirty = False
+        if not self.enabled:
+            return
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            if raw.get("schema") == CACHE_SCHEMA:
+                self._data = raw.get("files", {})
+        except (OSError, ValueError):
+            self._data = {}
+
+    # -- interfaces --------------------------------------------------------
+
+    def get_interface(self, apath: str, sha: str) -> ModuleInterface | None:
+        if not self.enabled:
+            return None
+        entry = self._data.get(apath)
+        if entry is None or entry.get("sha") != sha:
+            return None
+        try:
+            return ModuleInterface.from_dict(entry["interface"])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def put_interface(self, apath: str, sha: str,
+                      iface: ModuleInterface) -> None:
+        if not self.enabled:
+            return
+        self._data[apath] = {"sha": sha, "interface": iface.to_dict(),
+                             "engine1": {}}
+        self._dirty = True
+
+    # -- engine-1 findings -------------------------------------------------
+
+    def get_findings(self, apath: str, sha: str,
+                     env: str) -> list[Finding] | None:
+        if not self.enabled:
+            return None
+        entry = self._data.get(apath)
+        if entry is None or entry.get("sha") != sha:
+            return None
+        rows = entry.get("engine1", {}).get(env)
+        if rows is None:
+            return None
+        try:
+            return [Finding(*row) for row in rows]
+        except TypeError:
+            return None
+
+    def put_findings(self, apath: str, sha: str, env: str,
+                     findings: list[Finding]) -> None:
+        if not self.enabled:
+            return
+        entry = self._data.get(apath)
+        if entry is None or entry.get("sha") != sha:
+            return
+        entry.setdefault("engine1", {})[env] = [
+            [f.file, f.line, f.rule, f.message] for f in findings]
+        self._dirty = True
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self) -> None:
+        if not (self.enabled and self._dirty):
+            return
+        payload = {"schema": CACHE_SCHEMA, "files": self._data}
+        d = os.path.dirname(self.path) or "."
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # caching is an optimization, never a failure

@@ -1,0 +1,918 @@
+"""Whole-program call graph for sgplint (the Engine 3 substrate).
+
+This module turns every linted file into a compact, JSON-serializable
+:class:`ModuleInterface` — its function table, call edges, collective
+ops, branch/loop/kernel sites — and composes them into a
+:class:`CallGraph` whose **full transitive fixpoint closure** replaces
+the old one-import-hop seeding: tracedness now propagates along call
+edges across any number of modules until nothing changes, so a helper
+two-plus hops from a ``@jax.jit`` root is linted as traced in its own
+module (the ROADMAP item the one-hop limit carried).
+
+Interfaces are pure data (no AST retained), which is what makes the
+lint cache (:mod:`.cache`) work: a file whose content hash is unchanged
+contributes its interface without being re-parsed, the closure runs
+over interfaces only, and Engine 3's interprocedural rules
+(:mod:`.spmd`) never need an AST at all.
+
+Resolution stays precision-first, like the rest of sgplint: a call
+edge exists only when it resolves unambiguously through the module's
+own imports (``from .sib import helper`` name-calls, ``sib.helper``
+module-attribute calls); ambiguous or dynamic targets contribute no
+edge.  Cross-module edges bind module *top-level* names only — a
+from-import cannot name a method or a nested function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from .astlint import (
+    _Module,
+    _func_name_args,
+    _module_axes,
+    _resolve_import,
+    _TRACING_WRAPPERS,
+)
+
+__all__ = ["ModuleInterface", "CallGraph", "build_graph",
+           "MODULE_BODY", "SEQ_COLLECTIVES"]
+
+# the synthetic function name holding a module's top-level statements
+# (scripts dispatch compiled steps from module scope)
+MODULE_BODY = "<module>"
+
+# collectives whose *sequence* must agree across every rank: a rank
+# that skips (or reorders) one of these hangs the program.  axis_index /
+# axis_size are deliberately absent — they read local state and ship
+# nothing.
+SEQ_COLLECTIVES = {
+    "jax.lax.ppermute": "ppermute",
+    "jax.lax.pshuffle": "pshuffle",
+    "jax.lax.psum": "psum",
+    "jax.lax.pmean": "pmean",
+    "jax.lax.pmax": "pmax",
+    "jax.lax.pmin": "pmin",
+    "jax.lax.psum_scatter": "psum_scatter",
+    "jax.lax.all_gather": "all_gather",
+    "jax.lax.all_to_all": "all_to_all",
+}
+
+# the fused Pallas edge transport communicates like a ppermute and
+# joins the sequence vocabulary under its own name
+_KERNEL_COLLECTIVE = "gossip_edge_axpy"
+
+# host-side reads that drain the dispatch queue (the SGPL012 escape
+# hatch): any of these in a dispatch loop's body serializes it
+_BLOCKING_CALLS = {
+    "jax.block_until_ready", "jax.device_get", "jax.effects_barrier",
+    "np.asarray", "np.array", "float",
+}
+_BLOCKING_ATTRS = {"block_until_ready", "item", "tolist"}
+_BLOCKING_PREFIXES = ("np.testing.",)
+
+# canonical prefixes whose calls are pure device math (or host-pure
+# helpers) and can never hide a named-axis collective: they contribute
+# nothing to a collective signature instead of poisoning it to UNKNOWN
+_BENIGN_PREFIXES = ("jax.numpy.", "jax.nn.", "jax.tree", "jax.random.",
+                    "jax.debug.", "np.", "math.", "functools.")
+_BENIGN_CALLS = {"len", "range", "enumerate", "zip", "isinstance",
+                 "getattr", "tuple", "list", "dict", "min", "max", "abs",
+                 "sum", "jax.numpy", "int", "bool", "str", "print",
+                 "functools.partial", "partial"}
+
+_BRANCH_SITES = {"jax.lax.cond": "cond", "jax.lax.switch": "switch",
+                 "jax.lax.while_loop": "while_loop"}
+
+# DMA / semaphore vocabulary for the Pallas hygiene checks (SGPL013)
+_DMA_MAKERS = ("make_async_remote_copy", "make_async_copy")
+_PALLAS_CALL = "pallas_call"
+
+
+# -- interface dataclasses ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function's summary: enough to close the call graph and run
+    Engine 3 without the AST."""
+
+    qualname: str
+    name: str
+    lineno: int = 0
+    top_level: bool = False
+    parent: str | None = None          # enclosing function qualname
+    traced_root: bool = False          # decorator / wrapper-traced
+    # ordered flow events: ("coll", line, op) | ("call", line, kind,
+    # head, attr) with kind "name" (bare call) or "attr" (head.attr())
+    events: list = dataclasses.field(default_factory=list)
+    blocking: bool = False             # direct blocking read in body
+    branch_sites: list = dataclasses.field(default_factory=list)
+    loop_sites: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        d["events"] = [tuple(e) for e in d.get("events", [])]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ModuleInterface:
+    """Per-file summary: the function table plus everything Engine 3
+    and the closure need.  JSON-round-trippable for the lint cache."""
+
+    path: str
+    functions: dict = dataclasses.field(default_factory=dict)
+    from_imports: list = dataclasses.field(default_factory=list)
+    # bare names handed to a tracing wrapper anywhere in the module
+    # (jax.jit(step), jit(shard_map(step, ...)))
+    wrapper_handoffs: list = dataclasses.field(default_factory=list)
+    # name -> [wrapped bare names]: step = jax.jit(fn) bindings, so a
+    # dispatch loop calling step() resolves to fn
+    wrapper_bindings: dict = dataclasses.field(default_factory=dict)
+    # (line, literal value, suppressed) for collective_id=<int> kwargs
+    collective_id_sites: list = dataclasses.field(default_factory=list)
+    # pre-computed local SGPL013 findings: (line, message) — DMA/
+    # semaphore hygiene is local to a kernel body
+    kernel_findings: list = dataclasses.field(default_factory=list)
+    # mesh axis names this file declares (vocabulary contribution)
+    axes: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["functions"] = {q: f.to_dict() if isinstance(f, FuncInfo) else f
+                          for q, f in self.functions.items()}
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        d["functions"] = {q: FuncInfo.from_dict(f)
+                          for q, f in d["functions"].items()}
+        d["from_imports"] = [tuple(t) for t in d.get("from_imports", [])]
+        d["collective_id_sites"] = [tuple(t) for t in
+                                    d.get("collective_id_sites", [])]
+        d["kernel_findings"] = [tuple(t) for t in
+                                d.get("kernel_findings", [])]
+        return cls(**d)
+
+    def by_name(self, name: str) -> list[FuncInfo]:
+        return [f for f in self.functions.values() if f.name == name]
+
+    def top_level_named(self, name: str) -> list[FuncInfo]:
+        return [f for f in self.functions.values()
+                if f.name == name and f.top_level]
+
+
+# -- extraction --------------------------------------------------------------
+
+
+def _is_traced_decorator(mod: _Module, dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = mod.canonical(target)
+    if name in _TRACING_WRAPPERS:
+        return True
+    return (isinstance(dec, ast.Call)
+            and name in ("functools.partial", "partial") and dec.args
+            and mod.canonical(dec.args[0]) in _TRACING_WRAPPERS)
+
+
+def _handed_names(mod: _Module, call: ast.Call) -> list[str]:
+    """Bare names handed to a tracing wrapper, through nesting/partial:
+    ``jax.jit(shard_map(step, ...))`` yields ``step``."""
+    fn, args = _func_name_args(mod, call)
+    if fn not in _TRACING_WRAPPERS:
+        return []
+    out, stack = [], list(args[:1])
+    while stack:
+        a = stack.pop()
+        if isinstance(a, ast.Name):
+            out.append(a.id)
+        elif isinstance(a, ast.Call):
+            if mod.canonical(a.func) in ("functools.partial", "partial"):
+                stack.extend(a.args[:1])
+            else:
+                _, inner = _func_name_args(mod, a)
+                stack.extend(inner[:1])
+    return out
+
+
+def _call_ref(mod: _Module, func: ast.AST):
+    """("name", id) / ("attr", head, attr) for a call target, else None."""
+    if isinstance(func, ast.Name):
+        return ("name", func.id)
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return ("attr", func.value.id, func.attr)
+    return None
+
+
+def _branch_ref(mod: _Module, node: ast.AST, synth):
+    """A branch-callable reference for SGPL011, else None.
+
+    ``synth(lambda_node)`` registers an inline lambda as a synthetic
+    function and returns its qualname.
+    """
+    if isinstance(node, ast.Name):
+        return ["name", node.id]
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return ["attr", node.value.id, node.attr]
+    if isinstance(node, ast.Lambda):
+        return ["qual", synth(node)]
+    if isinstance(node, ast.Call):
+        fn = mod.canonical(node.func)
+        if fn in ("functools.partial", "partial") and node.args:
+            return _branch_ref(mod, node.args[0], synth)
+    return None
+
+
+class _Extractor:
+    """One pass over a parsed module producing its ModuleInterface."""
+
+    def __init__(self, mod: _Module):
+        self.mod = mod
+        self.iface = ModuleInterface(path=mod.path)
+        self.iface.from_imports = [tuple(t) for t in mod.from_imports]
+        self.iface.axes = sorted(_module_axes(mod))
+        self._synth_n = 0
+
+    def run(self) -> ModuleInterface:
+        mod_fn = FuncInfo(qualname=MODULE_BODY, name=MODULE_BODY,
+                          top_level=False)
+        self.iface.functions[MODULE_BODY] = mod_fn
+        self._walk_body(self.mod.tree.body, mod_fn, prefix="")
+        # module-wide scans that don't care about scope
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Call):
+                for name in _handed_names(self.mod, node):
+                    self.iface.wrapper_handoffs.append(name)
+                self._scan_collective_id(node)
+                self._scan_pallas_call(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                handed = _handed_names(self.mod, node.value)
+                if handed:
+                    self.iface.wrapper_bindings.setdefault(
+                        node.targets[0].id, []).extend(handed)
+        return self.iface
+
+    # -- scope walk --------------------------------------------------------
+
+    def _walk_body(self, body, fn: FuncInfo, prefix: str) -> None:
+        for node in body:
+            self._walk_stmt(node, fn, prefix)
+
+    def _walk_stmt(self, node, fn: FuncInfo, prefix: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._add_function(node, fn, prefix)
+            return
+        if isinstance(node, ast.ClassDef):
+            cprefix = f"{prefix}{node.name}." if prefix else f"{node.name}."
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_function(sub, None, cprefix, method=True)
+                else:
+                    self._walk_stmt(sub, fn, cprefix)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            self._add_loop(node, fn, prefix)
+            # loop bodies still contribute events/nested defs to the
+            # enclosing flow
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                self._walk_stmt(child, fn, prefix)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, fn, prefix)
+            else:
+                self._walk_expr(child, fn)
+
+    def _add_function(self, node, parent: FuncInfo | None, prefix: str,
+                      method: bool = False) -> None:
+        qual = f"{prefix}{node.name}@{node.lineno}"
+        info = FuncInfo(
+            qualname=qual, name=node.name, lineno=node.lineno,
+            top_level=(parent is not None
+                       and parent.qualname == MODULE_BODY and not method),
+            parent=(parent.qualname if parent is not None
+                    and parent.qualname != MODULE_BODY else None),
+            traced_root=any(_is_traced_decorator(self.mod, d)
+                            for d in node.decorator_list))
+        self.iface.functions[qual] = info
+        self._walk_body(node.body, info, prefix=f"{prefix}{node.name}.")
+
+    # -- expression flow ---------------------------------------------------
+
+    def _walk_expr(self, node, fn: FuncInfo) -> None:
+        """Record flow events in source order, descending into
+        expressions (lambdas included) but never into nested defs."""
+        if node is None or isinstance(node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, fn)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk_expr(child, fn)
+
+    def _record_call(self, node: ast.Call, fn: FuncInfo) -> None:
+        name = self.mod.canonical(node.func)
+        line = node.lineno
+        if name in SEQ_COLLECTIVES:
+            fn.events.append(("coll", line, SEQ_COLLECTIVES[name]))
+        elif name is not None and (
+                name == _KERNEL_COLLECTIVE
+                or name.endswith("." + _KERNEL_COLLECTIVE)):
+            fn.events.append(("coll", line, _KERNEL_COLLECTIVE))
+        elif name in _BRANCH_SITES:
+            self._add_branch_site(node, fn, _BRANCH_SITES[name])
+            # selector/operand expressions still flow (a collective in
+            # the *selector* executes unconditionally)
+            for a in node.args[:1]:
+                self._walk_expr(a, fn)
+            start = 3 if _BRANCH_SITES[name] != "switch" else 2
+            for a in node.args[start:]:
+                self._walk_expr(a, fn)
+            return
+        else:
+            if self._is_blocking(node, name):
+                fn.blocking = True
+            ref = _call_ref(self.mod, node.func)
+            if ref is not None and not self._is_benign(name):
+                fn.events.append(("call", line) + ref)
+        for child in list(node.args) + [k.value for k in node.keywords]:
+            self._walk_expr(child, fn)
+
+    def _is_blocking(self, node: ast.Call, name: str | None) -> bool:
+        if name in _BLOCKING_CALLS:
+            return True
+        if name and name.startswith(_BLOCKING_PREFIXES):
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_ATTRS)
+
+    def _is_benign(self, name: str | None) -> bool:
+        if name is None:
+            return False
+        if name in _BENIGN_CALLS:
+            return True
+        return name.startswith(_BENIGN_PREFIXES)
+
+    # -- SGPL011 branch sites ---------------------------------------------
+
+    def _add_branch_site(self, node: ast.Call, fn: FuncInfo,
+                         kind: str) -> None:
+        def synth(lam: ast.Lambda) -> str:
+            self._synth_n += 1
+            qual = f"<lambda#{self._synth_n}>@{lam.lineno}"
+            info = FuncInfo(qualname=qual, name=qual, lineno=lam.lineno)
+            self.iface.functions[qual] = info
+            self._walk_expr(lam.body, info)
+            return qual
+
+        branches = []
+        if kind == "cond":
+            cands = node.args[1:3]
+        elif kind == "while_loop":
+            cands = node.args[0:2]
+        else:  # switch: the branch list must be a literal sequence
+            cands = []
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  (ast.List, ast.Tuple)):
+                cands = node.args[1].elts
+        for c in cands:
+            branches.append(_branch_ref(self.mod, c, synth))
+        expected = 2 if kind in ("cond", "while_loop") else len(branches)
+        if not branches or len(branches) < expected:
+            return
+        fn.branch_sites.append({
+            "line": node.lineno, "kind": kind, "branches": branches,
+            "suppressed": self.mod.suppressed(node.lineno, "SGPL011"),
+        })
+
+    # -- SGPL012 loop sites ------------------------------------------------
+
+    def _add_loop(self, node, fn: FuncInfo, prefix: str) -> None:
+        trips = None          # None = unbounded / not statically known
+        kind = "while"
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            kind = "for"
+            it = node.iter
+            if isinstance(it, ast.Call) \
+                    and self.mod.canonical(it.func) == "range":
+                stop = it.args[-1] if len(it.args) <= 2 else it.args[1]
+                if isinstance(stop, ast.Constant) \
+                        and isinstance(stop.value, int):
+                    trips = stop.value
+                else:
+                    trips = -1   # range(<dynamic>)
+            else:
+                return           # iterating data, not dispatch counts
+        calls, blocking = [], False
+
+        def scan(n):
+            nonlocal blocking
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                return
+            if isinstance(n, ast.Call):
+                name = self.mod.canonical(n.func)
+                if self._is_blocking(n, name):
+                    blocking = True
+                ref = _call_ref(self.mod, n.func)
+                if ref is not None:
+                    calls.append(list(ref))
+            for child in ast.iter_child_nodes(n):
+                scan(child)
+
+        for child in node.body:
+            scan(child)
+        fn.loop_sites.append({
+            "line": node.lineno, "kind": kind, "trips": trips,
+            "calls": calls, "blocking": blocking,
+            "suppressed": self.mod.suppressed(node.lineno, "SGPL012"),
+        })
+
+    # -- SGPL013 collective_id + kernel hygiene ----------------------------
+
+    def _scan_collective_id(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg == "collective_id" \
+                    and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                self.iface.collective_id_sites.append(
+                    (node.lineno, int(kw.value.value),
+                     self.mod.suppressed(node.lineno, "SGPL013")))
+
+    def _scan_pallas_call(self, node: ast.Call) -> None:
+        name = self.mod.canonical(node.func) or ""
+        if not (name == _PALLAS_CALL or name.endswith("." + _PALLAS_CALL)):
+            return
+        if not node.args:
+            return
+        kernel = self._resolve_kernel(node.args[0])
+        if kernel is None:
+            return
+        for line, msg in _check_kernel_hygiene(self.mod, kernel):
+            if not self.mod.suppressed(line, "SGPL013"):
+                self.iface.kernel_findings.append((line, msg))
+
+    def _resolve_kernel(self, arg: ast.AST):
+        """The FunctionDef a pallas_call's kernel argument names —
+        directly, through ``functools.partial``, or through a local
+        ``kernel = functools.partial(K, ...)`` binding."""
+        target = None
+        if isinstance(arg, ast.Call):
+            fn = self.mod.canonical(arg.func)
+            if fn in ("functools.partial", "partial") and arg.args \
+                    and isinstance(arg.args[0], ast.Name):
+                target = arg.args[0].id
+        elif isinstance(arg, ast.Name):
+            target = arg.id
+            for n in ast.walk(self.mod.tree):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and n.targets[0].id == target \
+                        and isinstance(n.value, ast.Call):
+                    fn = self.mod.canonical(n.value.func)
+                    if fn in ("functools.partial", "partial") \
+                            and n.value.args \
+                            and isinstance(n.value.args[0], ast.Name):
+                        target = n.value.args[0].id
+                        break
+        if target is None:
+            return None
+        for n in ast.walk(self.mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == target:
+                return n
+        return None
+
+
+# -- Pallas DMA / semaphore hygiene (local to one kernel body) ---------------
+
+
+def _check_kernel_hygiene(mod: _Module, kernel) -> list[tuple[int, str]]:
+    """SGPL013 local checks on one Pallas kernel body:
+
+    * every ``make_async_remote_copy`` / ``make_async_copy`` descriptor
+      must have a ``.wait()`` on all control paths;
+    * barrier-semaphore signal arity must match the wait amount.
+    """
+    out: list[tuple[int, str]] = []
+
+    # conditional ancestry: line spans of every `if` inside the kernel
+    # and of every nested def gated by a pl.when decorator
+    cond_spans: list[tuple[int, int]] = []
+    for n in ast.walk(kernel):
+        if isinstance(n, ast.If):
+            cond_spans.append((n.lineno, n.end_lineno or n.lineno))
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not kernel:
+            for dec in n.decorator_list:
+                name = mod.canonical(dec.func if isinstance(dec, ast.Call)
+                                     else dec) or ""
+                if name.endswith(".when") or name == "when":
+                    cond_spans.append((n.lineno, n.end_lineno or n.lineno))
+
+    def conditional(line: int) -> bool:
+        return any(a <= line <= b for a, b in cond_spans)
+
+    # descriptor tracking: direct bindings, list-appended bindings,
+    # and loop variables iterating a tracked list
+    makes: dict[str, int] = {}       # var -> make line
+    list_makes: dict[str, int] = {}  # list var -> first make line
+    waits: dict[str, list[int]] = {}
+    loop_vars: dict[str, str] = {}   # loop var -> list it iterates
+    unbound_starts: list[int] = []
+    unbound_waits = 0
+
+    for n in ast.walk(kernel):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Call):
+            name = mod.canonical(n.value.func) or ""
+            if name.endswith(_DMA_MAKERS):
+                makes[n.targets[0].id] = n.lineno
+        elif isinstance(n, (ast.For, ast.AsyncFor)) \
+                and isinstance(n.target, ast.Name) \
+                and isinstance(n.iter, ast.Name):
+            loop_vars[n.target.id] = n.iter.id
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            inner = n.func.value
+            if n.func.attr == "append" and isinstance(inner, ast.Name) \
+                    and n.args and isinstance(n.args[0], ast.Call):
+                made = mod.canonical(n.args[0].func) or ""
+                if made.endswith(_DMA_MAKERS):
+                    list_makes.setdefault(inner.id, n.lineno)
+            elif n.func.attr in ("wait", "start"):
+                if isinstance(inner, ast.Name):
+                    var = inner.id
+                    var = loop_vars.get(var, var)
+                    if n.func.attr == "wait":
+                        waits.setdefault(var, []).append(n.lineno)
+                elif isinstance(inner, ast.Call):
+                    made = mod.canonical(inner.func) or ""
+                    if made.endswith(_DMA_MAKERS):
+                        if n.func.attr == "start":
+                            unbound_starts.append(n.lineno)
+                        else:
+                            unbound_waits += 1
+
+    for var, line in list(makes.items()) + list(list_makes.items()):
+        wl = waits.get(var, [])
+        if not wl:
+            out.append((line, f"async copy '{var}' is started but never "
+                        "waited — the DMA may still be in flight when "
+                        "its buffers are reused"))
+        elif not conditional(line) and all(conditional(w) for w in wl):
+            out.append((line, f"async copy '{var}' waits only on a "
+                        "conditional path — every control path that "
+                        "starts a DMA must wait it"))
+    for line in unbound_starts[unbound_waits:]:
+        out.append((line, "async copy started on an unbound descriptor "
+                    "with no matching re-made .wait()"))
+
+    # barrier semaphore arity
+    bsems: set[str] = set()
+    for n in ast.walk(kernel):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Call):
+            name = mod.canonical(n.value.func) or ""
+            if name.endswith("get_barrier_semaphore"):
+                bsems.add(n.targets[0].id)
+    if bsems:
+        signals = 0
+        wait_calls: list[tuple[int, int | None]] = []
+        for n in ast.walk(kernel):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)):
+                continue
+            name = mod.canonical(n.func) or ""
+            sem_arg = n.args[0] if n.args else None
+            on_bsem = isinstance(sem_arg, ast.Name) and sem_arg.id in bsems
+            if name.endswith("semaphore_signal") and on_bsem:
+                signals += 1
+            elif name.endswith("semaphore_wait") and on_bsem:
+                amount = None
+                if len(n.args) > 1 and isinstance(n.args[1], ast.Constant) \
+                        and isinstance(n.args[1].value, int):
+                    amount = n.args[1].value
+                wait_calls.append((n.lineno, amount))
+        if signals and not wait_calls:
+            out.append((kernel.lineno, f"barrier semaphore is signalled "
+                        f"{signals}x but never waited — the barrier "
+                        "never completes"))
+        for line, amount in wait_calls:
+            if amount is not None and amount != signals:
+                out.append((line, f"barrier semaphore waits for {amount} "
+                            f"signal(s) but the kernel sends {signals} — "
+                            "mismatched arity deadlocks the entry "
+                            "barrier"))
+    return out
+
+
+# -- the graph ---------------------------------------------------------------
+
+
+class CallGraph:
+    """Whole-program view over a set of module interfaces.
+
+    Tracedness is the **full transitive fixpoint**: starting from
+    decorator/wrapper roots, it propagates through lexical nesting,
+    same-module calls by bare name, and resolvable cross-module call
+    edges, repeatedly, until stable — however many import hops deep.
+    """
+
+    def __init__(self, interfaces: dict[str, ModuleInterface]):
+        self.interfaces = interfaces
+        known = set(interfaces)
+        # per module: local alias -> (target path, top-level name) for
+        # from-name imports; local alias -> target path for module
+        # imports
+        self.name_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        self.mod_imports: dict[str, dict[str, str]] = {}
+        for apath, iface in interfaces.items():
+            ni: dict[str, tuple[str, str]] = {}
+            mi: dict[str, str] = {}
+            for level, module, orig, alias in iface.from_imports:
+                sub = f"{module}.{orig}" if module else orig
+                target = _resolve_import(apath, level, sub, known)
+                if target is not None:        # `orig` IS a module
+                    mi[alias] = target
+                    continue
+                target = _resolve_import(apath, level, module, known)
+                if target is not None and target != apath:
+                    ni[alias] = (target, orig)
+            self.name_imports[apath] = ni
+            self.mod_imports[apath] = mi
+        self._traced: set[tuple[str, str]] = set()
+        self._sig_cache: dict[tuple[str, str], tuple | None] = {}
+        self._flag_cache: dict[tuple[str, tuple[str, str]], bool] = {}
+        self._edge_count = 0
+        self._cross_edge_count = 0
+        self._close()
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_call(self, apath: str, ref) -> list[tuple[str, FuncInfo]]:
+        """Functions a call reference may land on.  Bare names match
+        every same-named local def (mirroring the in-module closure)
+        plus an unambiguous from-import; module-attribute calls match
+        the target module's top-level name."""
+        iface = self.interfaces[apath]
+        kind = ref[0]
+        out: list[tuple[str, FuncInfo]] = []
+        if kind in ("name", "qual"):
+            name = ref[1]
+            if kind == "qual":
+                f = iface.functions.get(name)
+                return [(apath, f)] if f is not None else []
+            out.extend((apath, f) for f in iface.by_name(name))
+            for wrapped in iface.wrapper_bindings.get(name, ()):
+                out.extend((apath, f) for f in iface.by_name(wrapped))
+                imp = self.name_imports[apath].get(wrapped)
+                if imp is not None:
+                    tpath, orig = imp
+                    out.extend((tpath, f) for f in
+                               self.interfaces[tpath].top_level_named(orig))
+            imp = self.name_imports[apath].get(name)
+            if imp is not None:
+                tpath, orig = imp
+                out.extend((tpath, f) for f in
+                           self.interfaces[tpath].top_level_named(orig))
+        elif kind == "attr":
+            head, attr = ref[1], ref[2]
+            tpath = self.mod_imports[apath].get(head)
+            if tpath is not None:
+                out.extend((tpath, f) for f in
+                           self.interfaces[tpath].top_level_named(attr))
+        return out
+
+    def is_opaque(self, apath: str, ref) -> bool:
+        """True when a call target can hide arbitrary behavior from the
+        analysis: it resolves to nothing we know and is not a benign
+        library call.  (``self.method()`` is the canonical case.)"""
+        if self.resolve_call(apath, ref):
+            return False
+        if ref[0] == "attr":
+            head = ref[1]
+            if head in ("self", "cls"):
+                return True
+            # an attribute call through a resolvable module import that
+            # found no function (e.g. a class) is opaque too
+            return self.mod_imports[apath].get(head) is not None
+        # a bare name that is no local function, import, or binding:
+        # a callable parameter / dynamic value
+        iface = self.interfaces[apath]
+        name = ref[1]
+        return not (name in self.name_imports[apath]
+                    or iface.by_name(name)
+                    or name in iface.wrapper_bindings)
+
+    # -- traced fixpoint ---------------------------------------------------
+
+    def _close(self) -> None:
+        traced = self._traced
+        work: list[tuple[str, FuncInfo]] = []
+        children: dict[tuple[str, str], list[FuncInfo]] = {}
+        for apath, iface in self.interfaces.items():
+            for f in iface.functions.values():
+                if f.parent is not None:
+                    children.setdefault((apath, f.parent), []).append(f)
+                if f.traced_root:
+                    work.append((apath, f))
+            for name in iface.wrapper_handoffs:
+                for tpath, f in self.resolve_call(apath, ("name", name)):
+                    work.append((tpath, f))
+
+        def mark(apath: str, f: FuncInfo) -> None:
+            key = (apath, f.qualname)
+            if key in traced:
+                return
+            traced.add(key)
+            work.append((apath, f))
+
+        seen: set[tuple[str, str]] = set()
+        for apath, f in work:
+            mark(apath, f)
+        while work:
+            apath, f = work.pop()
+            key = (apath, f.qualname)
+            if key in seen:
+                continue
+            seen.add(key)
+            for child in children.get(key, ()):
+                mark(apath, child)
+            refs = [ev[2:] for ev in f.events if ev[0] == "call"]
+            # branch callables of lax.cond/switch/while_loop sites run
+            # under the same trace as their caller
+            refs.extend(tuple(r) for site in f.branch_sites
+                        for r in site["branches"] if r is not None)
+            for ref in refs:
+                targets = self.resolve_call(apath, ref)
+                self._edge_count += len(targets)
+                for tpath, g in targets:
+                    if tpath != apath:
+                        self._cross_edge_count += 1
+                        if not g.top_level:
+                            continue
+                    mark(tpath, g)
+
+    def is_traced(self, apath: str, f: FuncInfo) -> bool:
+        return (apath, f.qualname) in self._traced
+
+    def traced_seeds(self, apath: str) -> frozenset[str]:
+        """Top-level function names in this module traced by the
+        closure — the seed set Engine 1's in-module fixpoint continues
+        from (same contract as the old one-hop seeding, minus the hop
+        limit)."""
+        iface = self.interfaces.get(apath)
+        if iface is None:
+            return frozenset()
+        return frozenset(
+            f.name for f in iface.functions.values()
+            if f.top_level and (apath, f.qualname) in self._traced)
+
+    # -- transitive properties --------------------------------------------
+
+    def signature(self, apath: str, f: FuncInfo,
+                  _stack: frozenset = frozenset()) -> tuple | None:
+        """Ordered tuple of collective ops this function executes,
+        resolved transitively; ``None`` when an opaque call makes the
+        sequence unknowable (precision over recall)."""
+        key = (apath, f.qualname)
+        if key in self._sig_cache:
+            return self._sig_cache[key]
+        if key in _stack:
+            return None                      # recursion: unknowable
+        sig: list[str] = []
+        ok = True
+        for ev in f.events:
+            if ev[0] == "coll":
+                sig.append(ev[2])
+                continue
+            ref = ev[2:]
+            targets = self.resolve_call(apath, ref)
+            if not targets:
+                if self.is_opaque(apath, ref):
+                    ok = False
+                    break
+                continue
+            subs = {self.signature(tp, g, _stack | {key})
+                    for tp, g in targets}
+            if None in subs or len(subs) != 1:
+                ok = False
+                break
+            sig.extend(next(iter(subs)))
+        # a nested branch site contributes its own (matched) sequence;
+        # mismatched nested branches make the outer sequence unknowable
+        for site in f.branch_sites:
+            nested = self._branch_sigs(apath, site)
+            if nested is None or len({s for s in nested}) != 1:
+                ok = False
+                break
+            sig.extend(nested[0])
+        result = tuple(sig) if ok else None
+        self._sig_cache[key] = result
+        return result
+
+    def _branch_sigs(self, apath: str, site) -> list[tuple] | None:
+        """Per-branch collective signatures for a branch site, or None
+        when any branch is unresolvable/unknowable."""
+        sigs: list[tuple] = []
+        for ref in site["branches"]:
+            if ref is None:
+                return None
+            targets = self.resolve_call(apath, tuple(ref))
+            if not targets:
+                return None
+            subs = {self.signature(tp, g) for tp, g in targets}
+            if None in subs or len(subs) != 1:
+                return None
+            sigs.append(next(iter(subs)))
+        return sigs
+
+    def _transitive_flag(self, flag: str, apath: str, f: FuncInfo,
+                         _stack: frozenset = frozenset()) -> bool:
+        """Existential transitive property ('blocking' or 'collective'):
+        True when this function or any *resolvable* callee has it."""
+        key = (flag, (apath, f.qualname))
+        if key in self._flag_cache:
+            return self._flag_cache[key]
+        if (apath, f.qualname) in _stack:
+            return False
+        found = f.blocking if flag == "blocking" else any(
+            ev[0] == "coll" for ev in f.events)
+        if not found:
+            stack = _stack | {(apath, f.qualname)}
+            for ev in f.events:
+                if ev[0] != "call":
+                    continue
+                for tp, g in self.resolve_call(apath, ev[2:]):
+                    if self._transitive_flag(flag, tp, g, stack):
+                        found = True
+                        break
+                if found:
+                    break
+            if not found and flag == "collective":
+                for site in f.branch_sites:
+                    for ref in site["branches"]:
+                        if ref is None:
+                            continue
+                        for tp, g in self.resolve_call(apath, tuple(ref)):
+                            if self._transitive_flag(flag, tp, g,
+                                                     _stack | {key[1]}):
+                                found = True
+        self._flag_cache[key] = found
+        return found
+
+    def has_collective(self, apath: str, f: FuncInfo) -> bool:
+        return self._transitive_flag("collective", apath, f)
+
+    def has_blocking(self, apath: str, f: FuncInfo) -> bool:
+        return self._transitive_flag("blocking", apath, f)
+
+    # -- artifact ----------------------------------------------------------
+
+    def to_report(self, relto: str | None = None) -> dict:
+        """Call-graph summary for the ``--report-json`` artifact."""
+        def rel(p):
+            return os.path.relpath(p, relto) if relto else p
+
+        per_module = []
+        for apath in sorted(self.interfaces):
+            iface = self.interfaces[apath]
+            funcs = [f for f in iface.functions.values()
+                     if f.qualname != MODULE_BODY]
+            traced = [f for f in funcs
+                      if (apath, f.qualname) in self._traced]
+            per_module.append({
+                "file": rel(apath).replace(os.sep, "/"),
+                "functions": len(funcs),
+                "traced": sorted(f.qualname for f in traced),
+            })
+        return {
+            "modules": len(self.interfaces),
+            "functions": sum(m["functions"] for m in per_module),
+            "traced_functions": sum(len(m["traced"]) for m in per_module),
+            "call_edges": self._edge_count,
+            "cross_module_edges": self._cross_edge_count,
+            "per_module": per_module,
+        }
+
+
+def extract_interface(mod: _Module) -> ModuleInterface:
+    return _Extractor(mod).run()
+
+
+def build_graph(interfaces: dict[str, ModuleInterface]) -> CallGraph:
+    return CallGraph(interfaces)

@@ -2,14 +2,27 @@
 
 Modes:
 
-* default / ``--check`` — run both engines over the package, compare
-  against the checked-in baseline, exit 1 on any new finding;
-* ``--update-baseline`` — rewrite the baseline to the current findings;
-* ``--files a.py b.py`` — AST-lint only the given files (pre-commit
-  mode; the semantic verifier and baseline comparison still run only in
-  full mode);
+* default / ``--check`` — run all three engines over the package,
+  ``scripts/`` and ``tests/`` (fixtures excluded), compare against the
+  checked-in baseline, exit 1 on any new finding **or any stale
+  baseline entry** (the ratchet: the grandfather list can only shrink);
+* ``--update-baseline`` — rewrite the baseline to the current findings
+  (deterministic: sorted, content-addressed entries);
+* ``--files a.py b.py`` — lint the given files against the
+  whole-program closure but report only their findings (pre-commit
+  mode; the semantic verifier and baseline comparison still run only
+  in full mode);
 * ``--report`` — print the spectral-gap report (worst configurations
-  first) after verification.
+  first) after verification;
+* ``--report-json PATH`` — dump the spectral-gap grid plus the Engine 3
+  call-graph summary as one JSON artifact;
+* ``--rules-md PATH`` — regenerate ``docs/sgplint_rules.md`` from the
+  rule catalog;
+* ``--no-cache`` — bypass the content-hash lint cache under
+  ``artifacts/``.
+
+The heavy imports (jax, the package itself) happen only in full mode:
+``--files`` stays pure-AST so the pre-commit hook is sub-second.
 """
 
 from __future__ import annotations
@@ -18,12 +31,13 @@ import argparse
 import os
 import sys
 
-from .astlint import collect_axis_vocabulary, lint_paths, lint_file
+from .astlint import lint_program
 from .findings import (RULES, load_baseline, partition_against_baseline,
-                       save_baseline)
-from .verifier import verify_package
+                       render_rules_markdown, save_baseline,
+                       stale_baseline_entries)
 
 DEFAULT_BASELINE = "sgplint.baseline.json"
+DEFAULT_CACHE = os.path.join("artifacts", "sgplint_cache.json")
 
 
 def repo_root() -> str:
@@ -36,18 +50,52 @@ def package_dir() -> str:
     return os.path.join(repo_root(), "stochastic_gradient_push_tpu")
 
 
-def run_full(baseline_path: str, update: bool, report: bool,
-             quiet: bool = False, report_json: str | None = None) -> int:
+def lint_targets() -> list[str]:
+    """The whole-program sweep: the package plus ``scripts/`` and
+    ``tests/``, minus fixture directories (deliberately-bad lint
+    fixtures must not gate CI)."""
     root = repo_root()
-    findings = lint_paths([package_dir()], relto=root)
+    targets = [package_dir()]
+    for sub in ("scripts", "tests"):
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for dirpath, dirnames, files in os.walk(d):
+            dirnames[:] = sorted(
+                x for x in dirnames
+                if x not in ("__pycache__", ".git", "fixtures"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    targets.append(os.path.join(dirpath, f))
+    return targets
+
+
+def _open_cache(no_cache: bool):
+    from .cache import LintCache
+
+    path = os.path.join(repo_root(), DEFAULT_CACHE)
+    return LintCache(path, enabled=not no_cache)
+
+
+def run_full(baseline_path: str, update: bool, report: bool,
+             quiet: bool = False, report_json: str | None = None,
+             no_cache: bool = False) -> int:
+    # imported here, not at module top: --files/--rules must not pay for
+    # jax + the package import
+    from .verifier import verify_package
+
+    root = repo_root()
+    findings, graph = lint_program(lint_targets(), relto=root,
+                                   cache=_open_cache(no_cache))
     sem, gaps = verify_package(relto=root)
     findings = sorted(findings + sem)
 
     baseline = load_baseline(baseline_path)
     new, old = partition_against_baseline(findings, baseline)
+    stale = stale_baseline_entries(findings, baseline)
 
     if report_json:
-        _write_gap_report(report_json, gaps)
+        _write_report(report_json, gaps, graph, root)
 
     if update:
         save_baseline(baseline_path, findings)
@@ -70,18 +118,28 @@ def run_full(baseline_path: str, update: bool, report: bool,
     if old and not quiet:
         print(f"({len(old)} grandfathered finding(s) suppressed by "
               f"baseline)", file=out)
+    if stale:
+        for key in stale:
+            print(f"stale baseline entry (no longer fires): "
+                  f"{key[0]} {key[1]} {key[2]}", file=out)
+        print(f"sgplint: {len(stale)} stale baseline entr(y/ies) — the "
+              f"grandfather list only shrinks; run --update-baseline",
+              file=out)
     if new:
         print(f"sgplint: {len(new)} new finding(s) "
               f"({len(findings)} total, {len(old)} baselined)", file=out)
+        return 1
+    if stale:
         return 1
     print(f"sgplint: clean ({len(old)} baselined, "
           f"{len(gaps)} schedule configurations verified)", file=out)
     return 0
 
 
-def _write_gap_report(path: str, gaps) -> None:
-    """Dump the full spectral-gap grid as a JSON artifact so CI can track
-    gap drift across PRs (sorted for stable diffs)."""
+def _write_report(path: str, gaps, graph, root: str) -> None:
+    """One JSON artifact for CI: the spectral-gap grid (gap-drift
+    tracking) plus the Engine 3 call-graph summary (sorted for stable
+    diffs)."""
     import json
 
     rows = [{"topology": g.topology, "world": g.world, "ppi": g.ppi,
@@ -91,23 +149,41 @@ def _write_gap_report(path: str, gaps) -> None:
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
-        json.dump({"configurations": len(rows), "gaps": rows}, f,
+        json.dump({"configurations": len(rows), "gaps": rows,
+                   "callgraph": graph.to_report(relto=root)}, f,
                   indent=1, sort_keys=True)
         f.write("\n")
 
 
-def run_files(files: list[str]) -> int:
+def _is_fixture(path: str) -> bool:
+    """Deliberately-bad lint fixtures are test data, not program code —
+    excluded from the full sweep and skipped (not linted) when staged."""
+    return "fixtures" in os.path.abspath(path).split(os.sep)
+
+
+def run_files(files: list[str], no_cache: bool = False) -> int:
     root = repo_root()
-    axes = collect_axis_vocabulary([package_dir()])
-    findings = []
     bad_args = []
+    named = []
     for f in files:
         if not os.path.exists(f):
             bad_args.append(f"{f}: no such file")
         elif not f.endswith(".py"):
             bad_args.append(f"{f}: not a .py file")
-        else:
-            findings.extend(lint_file(f, axes, relto=root))
+        elif not _is_fixture(f):
+            named.append(os.path.abspath(f))
+    findings = []
+    if named:
+        # the named files join the whole-program closure (so a staged
+        # helper is linted as its callers see it) but only their own
+        # findings are reported
+        all_findings, graph = lint_program(
+            lint_targets() + named, relto=root,
+            cache=_open_cache(no_cache))
+        wanted = {os.path.relpath(p, root).replace(os.sep, "/")
+                  for p in named} | set(named)
+        findings = [f for f in all_findings
+                    if f.file.replace(os.sep, "/") in wanted]
     for f in findings:
         print(f.render())
     for msg in bad_args:
@@ -126,37 +202,56 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="sgplint",
         description="JAX/TPU-aware static analysis for gossip schedules, "
-                    "collective usage, and trace safety")
+                    "collective usage, SPMD hazards, and trace safety")
     ap.add_argument("--check", action="store_true",
-                    help="full run: AST lint + schedule verifier vs "
-                         "baseline (default mode)")
+                    help="full run: AST lint + SPMD-hazard analysis + "
+                         "schedule verifier vs baseline (default mode)")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to the current findings")
     ap.add_argument("--files", nargs="*", default=None,
-                    help="AST-lint only these files (pre-commit mode)")
+                    help="lint only these files against the whole-"
+                         "program closure (pre-commit mode)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline path (default <repo>/"
                          f"{DEFAULT_BASELINE})")
     ap.add_argument("--report", action="store_true",
                     help="print the spectral-gap report")
     ap.add_argument("--report-json", default=None, metavar="PATH",
-                    help="write the full spectral-gap grid as a JSON "
-                         "artifact (CI gap-drift tracking)")
+                    help="write the spectral-gap grid + call-graph "
+                         "summary as a JSON artifact")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the content-hash lint cache under "
+                         "artifacts/")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--rules-md", default=None, metavar="PATH",
+                    help="write the generated rule-catalog markdown "
+                         "(docs/sgplint_rules.md) and exit")
     args = ap.parse_args(argv)
 
     if args.rules:
-        for rid, (summary, hint) in sorted(RULES.items()):
-            print(f"{rid}  {summary}\n        fix: {hint}")
+        for rid, rule in sorted(RULES.items()):
+            print(f"{rid} [{rule.severity}]  {rule.summary}\n"
+                  f"        fix: {rule.hint}")
+        return 0
+
+    if args.rules_md:
+        d = os.path.dirname(args.rules_md)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.rules_md, "w") as f:
+            f.write(render_rules_markdown())
+            f.write("\n")
+        print(f"rule catalog written to {args.rules_md}")
         return 0
 
     if args.files is not None:
-        return run_files(args.files)
+        return run_files(args.files, no_cache=args.no_cache)
 
     baseline = args.baseline or os.path.join(repo_root(), DEFAULT_BASELINE)
     return run_full(baseline, update=args.update_baseline,
-                    report=args.report, report_json=args.report_json)
+                    report=args.report, report_json=args.report_json,
+                    no_cache=args.no_cache)
 
 
 def console_main() -> int:

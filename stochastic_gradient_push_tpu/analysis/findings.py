@@ -3,83 +3,129 @@
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+from typing import NamedTuple
 
-__all__ = ["Finding", "RULES", "load_baseline", "save_baseline",
-           "partition_against_baseline"]
+__all__ = ["Finding", "RULES", "Rule", "load_baseline", "save_baseline",
+           "partition_against_baseline", "stale_baseline_entries",
+           "render_rules_markdown"]
 
 
-# rule id -> (summary, fix hint).  L-rules come from the AST engine,
-# V-rules from the semantic schedule verifier.  The catalog is the single
-# source of truth: ARCHITECTURE.md's rule table is generated from the same
-# ids, and tests assert every rule here has a firing fixture.
-RULES: dict[str, tuple[str, str]] = {
-    "SGPL001": (
+class Rule(NamedTuple):
+    """Catalog entry; tuple-shaped so ``RULES[id][1]`` (the hint) keeps
+    working for older call sites."""
+
+    summary: str
+    hint: str
+    severity: str = "error"   # "error" gates CI; "warning" is advisory
+
+
+# rule id -> (summary, fix hint, severity).  L-rules 001-010 come from
+# the per-module AST engine, 011-013 from the whole-program SPMD-hazard
+# engine, V-rules from the semantic schedule verifier.  The catalog is
+# the single source of truth: docs/sgplint_rules.md is generated from it
+# (`--rules-md`), and tests assert every rule here has a firing fixture.
+RULES: dict[str, Rule] = {
+    "SGPL001": Rule(
         "collective axis_name is not a declared mesh axis",
         "use an axis constant from parallel/mesh.py or train/lm.py "
         "(GOSSIP_AXIS, SEQ_AXIS, ...) or declare the axis on a Mesh"),
-    "SGPL002": (
+    "SGPL002": Rule(
         "host side effect inside jit/shard_map-traced code",
         "hoist the call out of the traced function, or use jax.debug.print "
         "/ jax.debug.callback for tracing-safe effects"),
-    "SGPL003": (
+    "SGPL003": Rule(
         "numpy RNG inside jit/shard_map-traced code (freezes at trace time)",
         "thread a jax.random key through the function instead"),
-    "SGPL004": (
+    "SGPL004": Rule(
         "Python control flow on a traced value (retraces or fails)",
         "use lax.cond/lax.select/jnp.where, or mark the operand static"),
-    "SGPL005": (
+    "SGPL005": Rule(
         "PRNG key reused across sampler calls without split/fold_in",
         "key, sub = jax.random.split(key) before each extra use"),
-    "SGPL006": (
+    "SGPL006": Rule(
         "argument donated to a jitted call is read after the call",
         "stop using the donated buffer, or drop donate_argnums for it"),
-    "SGPL007": (
+    "SGPL007": Rule(
         "bare/broad exception handler in library code",
         "catch the specific exception types the body can raise, or tag a "
-        "deliberate catch-all with '# sgplint: disable=SGPL007 (<why>)'"),
-    "SGPL008": (
+        "deliberate catch-all with '# sgplint: disable=SGPL007 (<why>)'",
+        severity="warning"),
+    "SGPL008": Rule(
         "global-state mutation inside jit/shard_map-traced code",
         "return the new value instead; traced functions must be pure"),
-    "SGPL009": (
+    "SGPL009": Rule(
         "telemetry span/event emission inside jit/shard_map-traced code "
         "(runs once at trace time, then never again — and a recording "
         "span would time tracing, not execution)",
         "emit spans/events from the host loop around the compiled call; "
         "in-graph signals must ride the metrics pytree instead "
-        "(resilience/monitor.py health_signals is the pattern)"),
-    "SGPL010": (
+        "(resilience/monitor.py health_signals is the pattern)",
+        severity="warning"),
+    "SGPL010": Rule(
         "raw .astype() wire cast on a ppermute payload outside "
         "parallel/wire.py (single-encode-path invariant: every byte the "
         "gossip wire ships goes through a WireCodec, so pricing, "
         "error feedback, and the compiled cast can never disagree)",
         "route the payload through a parallel/wire.py WireCodec "
         "(gossip_round(codec=...)) instead of casting inline"),
-    "SGPV101": (
+    "SGPL011": Rule(
+        "collective divergence: lax.cond/lax.switch branches carry "
+        "mismatched collective sequences, or a lax.while_loop runs "
+        "collectives under a predicate no collective made rank-uniform "
+        "(resolved transitively through the whole-program call graph) — "
+        "a rank taking the other branch stops matching its peers' "
+        "sends and the SPMD program hangs",
+        "make every branch execute the same collectives in the same "
+        "order (pad with zero-contributions if needed), or derive the "
+        "predicate from a collective reduction (psum/pmax) so all "
+        "ranks agree; if the predicate is provably rank-uniform, "
+        "waive with '# sgplint: disable=SGPL011 (<why uniform>)'"),
+    "SGPL012": Rule(
+        "unsynchronized dispatch loop: a host for/while dispatches a "
+        "compiled collective callee many times with no blocking read in "
+        "the loop body — the dispatch queue floods and in-process "
+        "collectives deadlock (the exact tier-1 CPU hang of PR 8)",
+        "read a result inside the loop (jax.block_until_ready, "
+        ".item(), np.asarray) to serialize dispatch, or waive a "
+        "deliberately pipelined loop with "
+        "'# sgplint: disable=SGPL012 (<why bounded>)'"),
+    "SGPL013": Rule(
+        "Pallas DMA/semaphore hygiene: an async copy without a .wait() "
+        "on every control path, barrier-semaphore signal/wait arity "
+        "mismatch, or a collective_id integer literal reused across "
+        "call sites (distinct collectives sharing a hardware slot "
+        "corrupt each other's semaphores)",
+        "wait every DMA you start on every path that starts it, match "
+        "barrier waits to the number of signals, and derive "
+        "collective_id from the COLLECTIVE_ID_SLOTS pool "
+        "(ops/gossip_kernel.py is the reference shape)"),
+    "SGPV101": Rule(
         "gossip phase sub-round is not a permutation (ppermute would drop "
         "or duplicate messages)",
         "fix the topology so each rank has exactly one in-edge per "
         "sub-round"),
-    "SGPV102": (
+    "SGPV102": Rule(
         "mixing matrix is not column-stochastic (push-sum mass not "
         "conserved)",
         "make self_weight[r] + sum(edge_weights[:, r]) == 1 for every rank"),
-    "SGPV103": (
+    "SGPV103": Rule(
         "rotation cycle is not an ergodic contraction (zero spectral gap; "
         "the paper's convergence rate assumes a positive gap)",
         "add edges or phases until the cycle product mixes every pair of "
         "ranks"),
-    "SGPV104": (
+    "SGPV104": Rule(
         "bilateral pairing row is not an involution (partner mismatch "
         "deadlocks the exchange)",
         "ensure pairing[p, pairing[p, r]] == r for every rank"),
-    "SGPV105": (
+    "SGPV105": Rule(
         "schedule generator raised unexpectedly for a supported "
         "configuration",
         "make the generator either produce a valid schedule or raise "
         "ValueError with a clear unsupported-configuration message"),
-    "SGPV106": (
+    "SGPV106": Rule(
         "overlap (double-buffered) schedule is broken: the staleness-"
         "shifted augmented matrix over (params, in-flight FIFO) is not "
         "column-stochastic or its cycle product does not contract — "
@@ -119,14 +165,27 @@ def load_baseline(path: str) -> set[tuple[str, str, str]]:
     return {(d["file"], d["rule"], d["message"]) for d in data["findings"]}
 
 
+def entry_id(key: tuple[str, str, str]) -> str:
+    """Content-addressed identity of one baseline entry: stable across
+    reorderings and line shifts, distinct for any text change."""
+    return hashlib.sha256("|".join(key).encode()).hexdigest()[:16]
+
+
 def save_baseline(path: str, findings: list[Finding]) -> None:
+    """Write the grandfather list deterministically: entries sorted by
+    key, each carrying its content-addressed id, keys sorted — the same
+    findings always produce byte-identical output (the ratchet diffs
+    cleanly and can only shrink)."""
     data = {
         "comment": "sgplint grandfather list — regenerate with "
                    "`python scripts/sgplint.py --update-baseline`; new "
-                   "findings are never tolerated, only these exact keys.",
+                   "findings are never tolerated, only these exact keys, "
+                   "and entries that stop firing must be removed (the "
+                   "check fails on stale entries).",
         "findings": [
-            {"file": f.file, "rule": f.rule, "message": f.message}
-            for f in sorted(findings)
+            {"id": entry_id(f.key()),
+             "file": f.file, "rule": f.rule, "message": f.message}
+            for f in sorted(set(findings))
         ],
     }
     with open(path, "w") as f:
@@ -142,3 +201,44 @@ def partition_against_baseline(findings: list[Finding],
     for f in findings:
         (old if f.key() in baseline else new).append(f)
     return new, old
+
+
+def stale_baseline_entries(findings: list[Finding],
+                           baseline: set[tuple[str, str, str]]
+                           ) -> list[tuple[str, str, str]]:
+    """Baseline entries that no longer fire.  The ratchet: a fixed
+    finding must leave the baseline in the same change, so the
+    grandfather list monotonically shrinks."""
+    live = {f.key() for f in findings}
+    return sorted(baseline - live)
+
+
+def render_rules_markdown() -> str:
+    """docs/sgplint_rules.md, generated from the catalog (the checked-in
+    file is pinned byte-identical to this output by a tier-1 test)."""
+    lines = [
+        "# sgplint rule catalog",
+        "",
+        "Generated from `analysis/findings.py` — do not edit by hand; "
+        "regenerate with `python scripts/sgplint.py --rules-md "
+        "docs/sgplint_rules.md`.",
+        "",
+        "Engines: **SGPL001–010** per-module AST lint, **SGPL011–013** "
+        "whole-program SPMD-hazard analysis over the call-graph closure, "
+        "**SGPV1xx** semantic schedule verifier.",
+        "",
+        "Waiver syntax: `# sgplint: disable=<RULE>[,<RULE>...] (<why>)` "
+        "on the offending line or the line above; `disable=all` silences "
+        "every rule for that line. Waivers require a justification by "
+        "convention — reviewers treat a bare waiver as a defect.",
+        "",
+    ]
+    for rid in sorted(RULES):
+        rule = RULES[rid]
+        lines.append(f"## {rid} ({rule.severity})")
+        lines.append("")
+        lines.append(rule.summary)
+        lines.append("")
+        lines.append(f"**Fix:** {rule.hint}")
+        lines.append("")
+    return "\n".join(lines)

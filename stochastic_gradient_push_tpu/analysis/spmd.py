@@ -1,0 +1,160 @@
+"""Engine 3: interprocedural SPMD-hazard rules over the call graph.
+
+Three rule families, all running on :class:`~.callgraph.CallGraph`
+interfaces (no AST access — everything they need was extracted once per
+file, which is what lets the lint cache skip unchanged files):
+
+* **SGPL011 collective divergence** — the branches of a ``lax.cond`` /
+  ``lax.switch`` must execute identical collective sequences (counts
+  *and* order), resolved transitively through the closure; a
+  ``lax.while_loop`` whose body runs collectives needs a rank-uniform
+  predicate (a collective reduction in its cond).  A rank that takes
+  the other branch stops matching its peers' sends and the program
+  hangs — the classic SPMD divergence bug.
+* **SGPL012 unsynchronized dispatch loop** — a host-side ``for`` /
+  ``while`` dispatching a compiled collective callee many times with no
+  blocking read anywhere in the loop body floods the dispatch queue;
+  on in-process multi-device CPU this deadlocks outright (the PR 8
+  tier-1 hang, root-caused twice).
+* **SGPL013 Pallas DMA/semaphore hygiene** — kernel-local checks
+  (every started async copy waited on all control paths, barrier
+  signal/wait arity) are pre-computed at extraction; the whole-program
+  half checked here is ``collective_id`` reuse: the same integer
+  literal at two call sites aliases two logically distinct collectives
+  onto one hardware slot, so ids must come from the
+  ``COLLECTIVE_ID_SLOTS`` pool instead (the PR 15 finding).
+
+Precision over recall throughout: a site is only reported when every
+callable involved resolves statically; opaque targets (``self.m()``,
+callable parameters, dynamically built branch lists) silence the site.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .callgraph import CallGraph, MODULE_BODY
+from .findings import Finding
+
+__all__ = ["analyze_program", "DISPATCH_LOOP_MIN_TRIPS"]
+
+# a compiled-collective callee dispatched fewer times than this without
+# a blocking read is presumed intentional pipelining, not a hazard
+# (the PR 8 hang needed ~60 queued steps; 8 is a conservative floor)
+DISPATCH_LOOP_MIN_TRIPS = 8
+
+
+def _fmt_sig(sig: tuple) -> str:
+    return "[" + ", ".join(sig) + "]" if sig else "[no collectives]"
+
+
+def analyze_program(graph: CallGraph,
+                    relto: str | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for apath in sorted(graph.interfaces):
+        rel = os.path.relpath(apath, relto) if relto else apath
+        rel = rel.replace(os.sep, "/")
+        iface = graph.interfaces[apath]
+        for func in iface.functions.values():
+            _check_divergence(graph, apath, rel, func, findings)
+            _check_dispatch_loops(graph, apath, rel, func, findings)
+        for line, msg in iface.kernel_findings:
+            findings.append(Finding(rel, line, "SGPL013", msg))
+    _check_collective_id_reuse(graph, relto, findings)
+    return sorted(findings)
+
+
+# -- SGPL011 -----------------------------------------------------------------
+
+
+def _check_divergence(graph, apath, rel, func, findings) -> None:
+    for site in func.branch_sites:
+        if site["suppressed"]:
+            continue
+        if site["kind"] == "while_loop":
+            sigs = graph._branch_sigs(apath, site)
+            if sigs is None:
+                continue
+            cond_sig, body_sig = sigs[0], sigs[1]
+            if body_sig and not cond_sig:
+                findings.append(Finding(
+                    rel, site["line"], "SGPL011",
+                    f"lax.while_loop body runs collectives "
+                    f"{_fmt_sig(body_sig)} but its cond predicate is "
+                    f"not made rank-uniform by a collective reduction "
+                    f"— ranks that exit early stop matching their "
+                    f"peers' sends"))
+            continue
+        sigs = graph._branch_sigs(apath, site)
+        if sigs is None or len(set(sigs)) <= 1:
+            continue
+        desc = "; ".join(f"branch {i}: {_fmt_sig(s)}"
+                         for i, s in enumerate(sigs))
+        findings.append(Finding(
+            rel, site["line"], "SGPL011",
+            f"lax.{site['kind']} branches carry mismatched collective "
+            f"sequences ({desc}) — unless the predicate is rank-uniform "
+            f"this diverges the SPMD program"))
+
+
+# -- SGPL012 -----------------------------------------------------------------
+
+
+def _check_dispatch_loops(graph, apath, rel, func, findings) -> None:
+    if func.qualname != MODULE_BODY and graph.is_traced(apath, func):
+        return  # traced loops are unrolled by the tracer, not dispatched
+    for site in func.loop_sites:
+        if site["suppressed"] or site["blocking"]:
+            continue
+        trips = site["trips"]
+        if site["kind"] == "for" and trips is not None and trips >= 0 \
+                and trips < DISPATCH_LOOP_MIN_TRIPS:
+            continue
+        dispatched = None
+        blocked = False
+        for ref in site["calls"]:
+            targets = graph.resolve_call(apath, tuple(ref))
+            for tpath, g in targets:
+                if graph.has_blocking(tpath, g):
+                    blocked = True
+                if dispatched is None and graph.is_traced(tpath, g) \
+                        and graph.has_collective(tpath, g):
+                    dispatched = g.name
+            if blocked:
+                break
+        if dispatched is None or blocked:
+            continue
+        n = ("an unbounded number of" if trips is None or trips < 0
+             else str(trips))
+        findings.append(Finding(
+            rel, site["line"], "SGPL012",
+            f"{site['kind']} loop dispatches compiled collective "
+            f"'{dispatched}' {n} times with no blocking read in the "
+            f"body — the dispatch queue can deadlock in-process "
+            f"collectives (the PR 8 hang); read a result or "
+            f"block_until_ready inside the loop"))
+
+
+# -- SGPL013 (whole-program half) --------------------------------------------
+
+
+def _check_collective_id_reuse(graph, relto, findings) -> None:
+    by_literal: dict[int, list[tuple[str, int]]] = {}
+    for apath, iface in graph.interfaces.items():
+        for line, value, suppressed in iface.collective_id_sites:
+            if not suppressed:
+                by_literal.setdefault(value, []).append((apath, line))
+    for value, sites in by_literal.items():
+        if len(sites) < 2:
+            continue  # one pinned literal is legitimate; reuse is not
+        for apath, line in sorted(sites):
+            rel = os.path.relpath(apath, relto) if relto else apath
+            others = ", ".join(
+                f"{os.path.relpath(p, relto) if relto else p}:{l}"
+                for p, l in sorted(sites) if (p, l) != (apath, line))
+            findings.append(Finding(
+                rel.replace(os.sep, "/"), line, "SGPL013",
+                f"collective_id={value} literal is reused at {others} — "
+                f"distinct collectives sharing a hardware slot corrupt "
+                f"each other's semaphores; derive ids from the "
+                f"COLLECTIVE_ID_SLOTS pool"))

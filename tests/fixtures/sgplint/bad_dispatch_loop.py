@@ -1,0 +1,57 @@
+"""SGPL012: the PR 8 tier-1 deadlock, reconstructed.
+
+A host loop dispatching a compiled collective step with no blocking
+read floods the dispatch queue; with in-process (multi-device CPU)
+collectives the runtime deadlocks outright — tier-1 hung exactly this
+way until the test loops were serialized.  The rule needs the loop to
+be host-side (untraced), the callee to resolve through the closure to
+traced code that ships a collective, the trip count to be at least
+``DISPATCH_LOOP_MIN_TRIPS``, and the body to contain no blocking read.
+``ok_dispatch_loop.py`` is the serialized good twin.
+"""
+
+import jax
+from jax import lax
+
+
+@jax.jit
+def gossip_step(x):
+    # the consensus update: push along the ring and fold in
+    return 0.5 * (x + lax.ppermute(x, "gossip", [(0, 1), (1, 0)]))
+
+
+def raw_step(x):
+    return x + lax.psum(x, "gossip")
+
+
+run_compiled = jax.jit(raw_step)
+
+
+def consensus_sweep(x):
+    # 60 queued compiled collectives, zero reads: the PR 8 shape
+    for _ in range(60):  # EXPECT: SGPL012
+        x = gossip_step(x)
+    return x
+
+
+def drain_until(x):
+    t = 0
+    # unbounded while: worse than the counted loop
+    while t < 100:  # EXPECT: SGPL012
+        x = gossip_step(x)
+        t += 1
+    return x
+
+
+def pipeline(x):
+    # dispatch through a jit-bound alias resolves the same way
+    for _ in range(32):  # EXPECT: SGPL012
+        x = run_compiled(x)
+    return x
+
+
+def warmup(x):
+    # below DISPATCH_LOOP_MIN_TRIPS: deliberate short pipelining is fine
+    for _ in range(3):
+        x = gossip_step(x)
+    return x

@@ -1,0 +1,85 @@
+"""SGPL011: collective divergence across structured-control-flow branches.
+
+All ranks of an SPMD program must execute the same collective sequence;
+a ``lax.cond``/``lax.switch`` whose branches carry different sequences
+hangs the moment one rank takes the other branch.  Engine 3 resolves
+each branch callable through the call graph and compares the ordered
+collective signatures.  Good shapes below pin the precision rules:
+matched sequences, collective-uniformized while predicates, and opaque
+branch targets (``self.method``) stay silent by design.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def push_half(x):
+    return lax.ppermute(x, "gossip", [(0, 1), (1, 0)])
+
+
+def fold_half(x):
+    return lax.psum(x, "gossip")
+
+
+def quiet_half(x):
+    return x * 2.0
+
+
+@jax.jit
+def step_cond(pred, x):
+    # one branch ships a ppermute, the other ships nothing
+    return lax.cond(pred, push_half, quiet_half, x)  # EXPECT: SGPL011
+
+
+@jax.jit
+def step_switch(idx, x):
+    # three branches, three different sequences
+    return lax.switch(idx, [push_half, fold_half, quiet_half], x)  # EXPECT: SGPL011
+
+
+@jax.jit
+def drain(x):
+    def not_done(carry):
+        return carry[1] < 4.0
+
+    def body(carry):
+        v, t = carry
+        return fold_half(v), t + 1.0
+
+    # the body runs a psum every iteration but nothing makes the exit
+    # predicate rank-uniform: ranks can disagree on the trip count
+    return lax.while_loop(not_done, body, (x, jnp.float32(0)))  # EXPECT: SGPL011
+
+
+# -- good shapes: silent by design ------------------------------------------
+
+
+@jax.jit
+def step_matched(pred, x):
+    # both branches run the same single ppermute: no divergence
+    return lax.cond(pred, push_half, lambda v: push_half(v), x)
+
+
+@jax.jit
+def drain_uniform(x):
+    def any_left(carry):
+        # the pmax makes the predicate identical on every rank
+        return lax.pmax(carry[1], "gossip") > 0
+
+    def body(carry):
+        v, t = carry
+        return fold_half(v), t - 1
+
+    return lax.while_loop(any_left, body, (x, jnp.int32(3)))
+
+
+class Mixer:
+    """Opaque branch targets silence the site (precision over recall):
+    ``self._mix`` cannot be resolved statically."""
+
+    def _mix(self, x):
+        return lax.psum(x, "gossip")
+
+    def maybe(self, pred, x):
+        return lax.cond(pred, lambda v: self._mix(v), lambda v: v, x)

@@ -1,0 +1,19 @@
+"""Entry half of the two-hop closure fixture.
+
+The jitted step calls ``mid_helper`` (one import hop), which calls
+``leaf_helper`` in a third module (two hops).  The old one-hop closure
+marked ``mid_helper`` traced but never saw the leaf; the full fixpoint
+closure keeps propagating and flags the leaf's host effect in the
+leaf's own module (see ``test_sgplint.py::
+test_two_hop_closure_reaches_the_leaf``).  Standalone, every file in
+the trio is clean.
+"""
+
+import jax
+
+from twohop_mid import mid_helper
+
+
+@jax.jit
+def step(x):
+    return mid_helper(x)

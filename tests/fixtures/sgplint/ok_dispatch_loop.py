@@ -1,0 +1,29 @@
+"""Good twin of ``bad_dispatch_loop.py``: serialized dispatch.
+
+Identical consensus loops, but every body contains a blocking read —
+``jax.block_until_ready`` or a host-side scalar read — so the dispatch
+queue drains each iteration.  This is exactly how the PR 8 hang was
+fixed in the tier-1 tests.  Zero findings expected.
+"""
+
+import jax
+from jax import lax
+
+
+@jax.jit
+def gossip_step(x):
+    return 0.5 * (x + lax.ppermute(x, "gossip", [(0, 1), (1, 0)]))
+
+
+def consensus_sweep_serialized(x):
+    for _ in range(60):
+        x = jax.block_until_ready(gossip_step(x))
+    return x
+
+
+def consensus_sweep_metrics(x):
+    total = 0.0
+    for _ in range(60):
+        x = gossip_step(x)
+        total += float(x[0])  # host read: blocks on the result
+    return x, total

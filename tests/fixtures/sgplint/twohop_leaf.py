@@ -1,0 +1,16 @@
+"""Leaf of the two-hop closure fixture: the hidden host effect.
+
+Two import hops from the jitted entry point — invisible to the old
+one-hop closure, flagged by the full fixpoint.  The marker line below
+deliberately does not match the ``# EXPECT:`` harness regex: this file
+must stay clean under standalone ``lint_file``.
+"""
+
+import time
+
+import jax.numpy as jnp
+
+
+def leaf_helper(x):
+    stamp = time.time()  # EXPECT-TWOHOP: SGPL002 (fixpoint closure only)
+    return x + jnp.asarray(stamp, x.dtype)

@@ -1,0 +1,12 @@
+"""Middle hop of the two-hop closure fixture: pure pass-through.
+
+Nothing here is traced by its own decorators; tracedness arrives from
+``bad_twohop.step`` through the closure and must continue one hop
+further into ``twohop_leaf``.
+"""
+
+from twohop_leaf import leaf_helper
+
+
+def mid_helper(x):
+    return leaf_helper(x) * 2.0
