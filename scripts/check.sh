@@ -59,6 +59,10 @@ echo "== fleet self-check (two-level: kill a slice -> rendezvous -> coordinated 
 python scripts/fleet.py --selftest
 
 echo
+echo "== serve self-check (train -> consensus ingest -> paged-attention serving) =="
+python scripts/serve.py --selftest
+
+echo
 echo "== tier-1 tests (CPU, not slow) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -290,6 +290,32 @@ def build_report(run_dir: str) -> dict:
             "outcome": final,
         }
 
+    # serving section: the run's `serve` summary event (serve/bench.py
+    # summarize() — byte-equal to artifacts/bench_serve.json by
+    # construction) cross-checked against the typed per-request stream
+    serve_evs = by_kind.get("serve", [])
+    request_evs = by_kind.get("request", [])
+    serving = None
+    if serve_evs or request_evs:
+        summary = next((ev["data"] for ev in reversed(serve_evs)
+                        if ev["data"].get("phase") == "summary"), None)
+        rejects = sum(1 for ev in serve_evs
+                      if ev["data"].get("phase") == "reject")
+        lat = PercentileMeter(maxlen=65536, ptag="request_latency_s")
+        req_tokens = 0
+        for ev in request_evs:
+            lat.update(float(ev["data"].get("latency_s", 0.0)))
+            req_tokens += int(ev["data"].get("new_tokens", 0))
+        serving = {
+            "summary": ({k: v for k, v in summary.items()
+                         if k != "phase"} if summary else None),
+            "requests_observed": len(request_evs),
+            "tokens_observed": req_tokens,
+            "p50_latency_s": round(lat.p50, 6),
+            "p99_latency_s": round(lat.p99, 6),
+            "rejections_observed": rejects,
+        }
+
     report = {
         "run_dir": run_dir,
         "trace_present": trace_present,
@@ -330,6 +356,7 @@ def build_report(run_dir: str) -> dict:
             "timeline": restart_timeline,
         },
         "fleet": fleet,
+        "serving": serving,
         "comm": comm_final,
         "ckpt_meta": load_ckpt_meta(run_dir),
     }
@@ -418,6 +445,31 @@ def render(report: dict) -> str:
             lines.append("   host generations: " + ", ".join(
                 f"h{h}={g}" for h, g in
                 sorted(fl["host_generations"].items())))
+    sv = report.get("serving")
+    if sv:
+        s = sv.get("summary")
+        if s:
+            lines.append(
+                f"serving: {s.get('requests')} request(s), "
+                f"{s.get('tokens')} token(s), "
+                f"{s.get('tokens_per_sec', 0.0):.1f} tok/s, latency "
+                f"p50 {s.get('p50_latency_s', 0.0)*1e3:.2f} ms  "
+                f"p99 {s.get('p99_latency_s', 0.0)*1e3:.2f} ms")
+            lines.append(
+                f"   pages: peak occupancy "
+                f"{s.get('page_occupancy_peak', 0.0):.0%}, admission "
+                f"rejections {s.get('admission_rejections', 0)}, kv "
+                f"{s.get('kv_bytes_per_token', 0):,} B/token, "
+                f"{s.get('decode_steps', 0)} decode step(s)")
+        else:
+            lines.append("serving: no summary event (run killed "
+                         "mid-serve?) — typed request stream only")
+        lines.append(
+            f"   request stream: {sv['requests_observed']} completion "
+            f"event(s), {sv['tokens_observed']} token(s), p50 "
+            f"{sv['p50_latency_s']*1e3:.2f} ms  p99 "
+            f"{sv['p99_latency_s']*1e3:.2f} ms, "
+            f"{sv['rejections_observed']} reject event(s)")
     c = report["comm"]
     if c:
         by = c.get("bytes", {})
@@ -559,6 +611,34 @@ def selftest() -> int:
                              "generation": 1, "cycles": 1})
         coord.close()
 
+        # a serving run: drive the real bench (synthetic engine) into a
+        # per-rank event stream + artifact, then hold the report's
+        # Serving rows to the artifact's numbers — they share
+        # serve.bench.summarize, so any drift is a real bug
+        from stochastic_gradient_push_tpu.serve.bench import (
+            SyntheticEngine, run_bench, synthetic_requests,
+            write_artifact)
+        from stochastic_gradient_push_tpu.serve.engine import ServeConfig
+        from stochastic_gradient_push_tpu.serve.scheduler import Request
+
+        base, ext = os.path.splitext(EVENTS_FILE)
+        srv = TelemetryRegistry(rank=1, sinks=[JsonlSink(
+            os.path.join(d, f"{base}_r1{ext}"))])
+        eng = SyntheticEngine(
+            ServeConfig(n_heads=1, page_size=4, num_pages=16,
+                        max_seqs=2, max_pages_per_seq=4),
+            kv_bytes_per_tok=1024)
+        reqs = synthetic_requests(12, seed=5, prompt_tokens=(2, 6),
+                                  new_tokens=(2, 5))
+        # budget 25 > the 16-token slot window: a permanent rejection
+        # the Serving section must count
+        reqs.append(Request(rid=999, prompt=(1,) * 20,
+                            max_new_tokens=5))
+        metrics, _ = run_bench(eng, reqs, registry=srv)
+        srv.close()
+        artifact_path = write_artifact(
+            os.path.join(d, "bench_serve.json"), metrics)
+
         report = build_report(d)
         print(render(report))
 
@@ -608,6 +688,29 @@ def selftest() -> int:
             acks = fl["cycles"][0]["acks"]
             expect(acks == {"0": 1.4e-8, "1": 1.4e-8},
                    f"coordinated reshard drift: {acks}")
+        # the Serving section, held row-for-row to the bench artifact
+        sv = report["serving"]
+        expect(sv is not None, "serving section missing")
+        if sv is not None:
+            with open(artifact_path) as f:
+                art = json.load(f)["bench"]
+            expect(sv["summary"] == art,
+                   f"serving summary != artifact: {sv['summary']} "
+                   f"vs {art}")
+            expect(sv["requests_observed"] == art["requests"],
+                   f"request events {sv['requests_observed']} != "
+                   f"artifact {art['requests']}")
+            expect(sv["tokens_observed"] == art["tokens"],
+                   f"request tokens {sv['tokens_observed']} != "
+                   f"artifact {art['tokens']}")
+            expect(abs(sv["p50_latency_s"] - art["p50_latency_s"])
+                   < 1e-6, "request-stream p50 != artifact p50")
+            expect(abs(sv["p99_latency_s"] - art["p99_latency_s"])
+                   < 1e-6, "request-stream p99 != artifact p99")
+            expect(sv["rejections_observed"]
+                   == art["admission_rejections"] == 1,
+                   f"rejection rows: {sv['rejections_observed']} vs "
+                   f"{art['admission_rejections']}")
         # the analytic gate: reported bytes equal the model's expectation
         want = model.totals(num_steps)
         want["recovery"] = allreduce_bytes(payload, 8)

@@ -1,0 +1,51 @@
+"""serve/ — gossip-trained checkpoints behind a paged-attention stack.
+
+The serving layer (L7 on the ARCHITECTURE map): consensus checkpoint
+ingest (``load``), KV page table (``pages``), paged-attention decode
+kernel (``paged_attention``), slot engine (``engine``), continuous
+batching (``scheduler``), synthetic-traffic bench (``bench``), and the
+decode-fleet child (``child``).
+
+The host-side pieces (pages, scheduler, bench, child) import without
+jax; the accelerator pieces load lazily so a supervisor-managed decode
+child stays numpy-only until it actually touches a model.
+"""
+
+from __future__ import annotations
+
+from .pages import PageCapacityError, PageTable
+from .scheduler import (AdmissionError, Completion, ContinuousBatcher,
+                        Request)
+
+__all__ = [
+    "AdmissionError", "Completion", "ContinuousBatcher", "LMEngine",
+    "MODEL_AXIS", "PageCapacityError", "PageTable", "Request",
+    "ServeConfig", "SyntheticEngine", "load_consensus",
+    "paged_attention_decode", "paged_attention_reference", "run_bench",
+    "sharded_paged_decode", "shard_params_for_decode",
+    "synthetic_requests",
+]
+
+_LAZY = {
+    "LMEngine": "engine",
+    "ServeConfig": "engine",
+    "MODEL_AXIS": "paged_attention",
+    "paged_attention_decode": "paged_attention",
+    "paged_attention_reference": "paged_attention",
+    "sharded_paged_decode": "paged_attention",
+    "load_consensus": "load",
+    "shard_params_for_decode": "load",
+    "SyntheticEngine": "bench",
+    "run_bench": "bench",
+    "synthetic_requests": "bench",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

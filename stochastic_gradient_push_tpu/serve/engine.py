@@ -1,0 +1,323 @@
+"""Decode engine: consensus TransformerLM params → tokens, via pages.
+
+The serving counterpart of ``models/transformer.py``: the same math
+(pre-norm blocks, rotary embeddings, fp32 LN/softmax, tanh-gelu MLP)
+re-expressed as two inference paths over an explicit parameter pytree:
+
+* **prefill** — the whole prompt in one pass through
+  ``ops.flash_attention.flash_attention`` (Pallas on TPU, blockwise
+  elsewhere), returning the per-layer roped k/v, which are scattered
+  into the sequence's KV pages;
+* **decode** — one token for every live slot per step, with
+  :func:`serve.paged_attention.paged_attention_decode` attending over
+  the page pool (KV-head sharded over the mesh's ``model`` axis via
+  :func:`sharded_paged_decode` when a mesh is given).
+
+The decode step is a single jit of fixed batch shape (``max_seqs``
+slots, always), so continuous batching never recompiles as sequences
+come and go: inactive slots decode a dummy token whose KV write lands
+in a reserved **sink page** (page id ``num_pages``, owned by nobody)
+and whose output is discarded on the host.  Page bookkeeping is the
+pure-python :class:`serve.pages.PageTable`; this module owns only the
+arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import typing as tp
+
+import numpy as np
+
+from .pages import PageTable, pages_for
+
+__all__ = ["ServeConfig", "LMEngine"]
+
+_LN_EPS = 1e-6       # flax.linen.LayerNorm default
+_ROPE_BASE = 10000.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Decode-engine shape knobs (the model's own shape is inferred
+    from the ingested params; only ``n_heads`` cannot be)."""
+
+    n_heads: int
+    page_size: int = 8
+    num_pages: int = 64
+    max_seqs: int = 4
+    max_pages_per_seq: int = 8
+    use_pallas: bool | None = None
+    interpret: bool = False
+
+    @property
+    def max_tokens_per_seq(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+# -- pure forward pieces (all jit-traced: no host effects in here) -----------
+
+
+def _ln(x, p):
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return ((x - mean) / jnp.sqrt(var + _LN_EPS)) * p["scale"] + p["bias"]
+
+
+def _rope_tok(x, positions):
+    """Rotary embedding for one token per sequence: ``x`` [B, H, D],
+    ``positions`` [B] (the models/transformer.py ``_rope`` with a
+    per-batch position instead of a shared [T] vector)."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    half = d // 2
+    freqs = _ROPE_BASE ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[:, None]        # [B, 1, half]
+    sin = jnp.sin(angles)[:, None]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def _mlp(params, h):
+    import jax
+
+    h = h @ params["up"]["kernel"] + params["up"]["bias"]
+    h = jax.nn.gelu(h)
+    return h @ params["down"]["kernel"] + params["down"]["bias"]
+
+
+def _prefill_fn(params, tokens, n_heads: int):
+    """Prompt pass.  ``tokens`` [t] → (logits [t, vocab], k, v
+    [layers, heads, t, head_dim], roped/cache-ready)."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import _rope
+    from ..ops.flash_attention import flash_attention
+
+    n_layers = _n_layers(params)
+    t = tokens.shape[0]
+    d_model = params["embed"]["embedding"].shape[1]
+    head_dim = d_model // n_heads
+    positions = jnp.arange(t)
+    x = params["embed"]["embedding"][tokens][None]          # [1, t, E]
+    ks, vs = [], []
+    for i in range(n_layers):
+        blk = params[f"block_{i}"]
+        h = _ln(x, blk["ln1"])
+
+        def split(y):
+            return y.reshape(1, t, n_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q = split(h @ blk["attn"]["q"]["kernel"])
+        k = split(h @ blk["attn"]["k"]["kernel"])
+        v = split(h @ blk["attn"]["v"]["kernel"])
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+        ks.append(k[0])
+        vs.append(v[0])
+        out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(1, t, d_model)
+        x = x + out @ blk["attn"]["o"]["kernel"]
+        x = x + _mlp(blk, _ln(x, blk["ln2"]))
+    x = _ln(x, params["ln_f"])
+    logits = (x @ params["lm_head"]["kernel"])[0]
+    return (jnp.asarray(logits, jnp.float32),
+            jnp.stack(ks), jnp.stack(vs))
+
+
+def _decode_fn(params, k_cache, v_cache, tokens, positions, dest_page,
+               dest_off, page_indices, lengths, *, n_heads: int,
+               mesh=None, use_pallas=None, interpret=False):
+    """One decode step for the full slot batch.  ``tokens``/``positions``
+    [B]; ``dest_page``/``dest_off`` [B] name each token's KV landing
+    spot (the sink page for inactive slots); caches are
+    [layers, heads, num_pages+1, page_size, head_dim] and are donated.
+    Returns (next_tokens [B], k_cache, v_cache)."""
+    import jax.numpy as jnp
+
+    from .paged_attention import paged_attention_decode, sharded_paged_decode
+
+    n_layers = _n_layers(params)
+    d_model = params["embed"]["embedding"].shape[1]
+    head_dim = d_model // n_heads
+    bsz = tokens.shape[0]
+    x = params["embed"]["embedding"][tokens]                # [B, E]
+    for i in range(n_layers):
+        blk = params[f"block_{i}"]
+        h = _ln(x, blk["ln1"])
+        q = (h @ blk["attn"]["q"]["kernel"]).reshape(bsz, n_heads, head_dim)
+        k = (h @ blk["attn"]["k"]["kernel"]).reshape(bsz, n_heads, head_dim)
+        v = (h @ blk["attn"]["v"]["kernel"]).reshape(bsz, n_heads, head_dim)
+        q = _rope_tok(q, positions)
+        k = _rope_tok(k, positions)
+        # scatter: cache[i, :, dest_page[b], dest_off[b]] = k[b] — the
+        # advanced indices straddle the head slice, so the broadcast
+        # batch dim lands first and the value is [B, H, D] as computed
+        k_cache = k_cache.at[i, :, dest_page, dest_off].set(k)
+        v_cache = v_cache.at[i, :, dest_page, dest_off].set(v)
+        if mesh is not None:
+            out = sharded_paged_decode(
+                mesh, q, k_cache[i], v_cache[i], page_indices, lengths,
+                use_pallas=use_pallas, interpret=interpret)
+        else:
+            out = paged_attention_decode(
+                q, k_cache[i], v_cache[i], page_indices, lengths,
+                use_pallas=use_pallas, interpret=interpret)
+        x = x + out.reshape(bsz, d_model) @ blk["attn"]["o"]["kernel"]
+        x = x + _mlp(blk, _ln(x, blk["ln2"]))
+    x = _ln(x, params["ln_f"])
+    logits = jnp.asarray(x @ params["lm_head"]["kernel"], jnp.float32)
+    return jnp.argmax(logits, -1).astype(jnp.int32), k_cache, v_cache
+
+
+def _n_layers(params) -> int:
+    return sum(1 for k in params if str(k).startswith("block_"))
+
+
+def _pad_len(t: int) -> int:
+    """Prompt pad bucket: next multiple of 8 (TPU sublane friendly, and
+    it caps distinct prefill compilations at t/8)."""
+    return max(8, -(-t // 8) * 8)
+
+
+class LMEngine:
+    """Slot-based decode engine over one consensus params tree.
+
+    The scheduler drives it through four calls: :meth:`can_admit`,
+    :meth:`start` (prefill a prompt into a fresh slot, returning the
+    first generated token), :meth:`step` (one greedy token for every
+    live slot), :meth:`finish` (release the slot's pages).
+    """
+
+    def __init__(self, params, config: ServeConfig, mesh=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.config = config
+        self.mesh = mesh
+        self.pages = PageTable(config.num_pages, config.page_size,
+                               config.max_seqs)
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.n_layers = _n_layers(params)
+        d_model = params["embed"]["embedding"].shape[1]
+        if d_model % config.n_heads:
+            raise ValueError(f"d_model {d_model} not divisible by "
+                             f"n_heads {config.n_heads}")
+        self.head_dim = d_model // config.n_heads
+        # +1 page: the sink, where inactive slots' dummy KV writes land
+        self._sink = config.num_pages
+        cache_shape = (self.n_layers, config.n_heads, config.num_pages + 1,
+                       config.page_size, self.head_dim)
+        self._kc = jnp.zeros(cache_shape, jnp.float32)
+        self._vc = jnp.zeros(cache_shape, jnp.float32)
+        self._last_tok = np.zeros(config.max_seqs, np.int32)
+        self._prefills: dict[int, tp.Any] = {}
+        self._decode = jax.jit(
+            functools.partial(
+                _decode_fn, n_heads=config.n_heads, mesh=mesh,
+                use_pallas=config.use_pallas,
+                interpret=config.interpret),
+            donate_argnums=(1, 2))
+
+    # -- admission ---------------------------------------------------------
+
+    def can_admit(self, budget_tokens: int) -> bool:
+        return (budget_tokens <= self.config.max_tokens_per_seq
+                and self.pages.can_fit(budget_tokens))
+
+    def start(self, prompt, budget_tokens: int):
+        """Prefill ``prompt`` into a fresh slot (the page table's typed
+        backpressure propagates) and return ``(slot, first_token)``."""
+        import jax.numpy as jnp
+
+        if not prompt:
+            raise ValueError("empty prompt")
+        if budget_tokens > self.config.max_tokens_per_seq:
+            raise ValueError(
+                f"budget {budget_tokens} tokens exceeds a slot's "
+                f"{self.config.max_tokens_per_seq}-token page window")
+        slot = self.pages.open(budget_tokens)
+        t = len(prompt)
+        padded = np.zeros(_pad_len(t), np.int32)
+        padded[:t] = prompt
+        fn = self._prefills.get(padded.shape[0])
+        if fn is None:
+            import jax
+            fn = jax.jit(functools.partial(
+                _prefill_fn, n_heads=self.config.n_heads))
+            self._prefills[padded.shape[0]] = fn
+        logits, ks, vs = fn(self.params, jnp.asarray(padded))
+        self.pages.append(slot, t)
+        # scatter the prompt's roped k/v into the slot's pages
+        size = self.config.page_size
+        for pi, page in enumerate(self.pages.pages_of(slot)):
+            lo = pi * size
+            n = min(size, t - lo)
+            self._kc = self._kc.at[:, :, page, :n].set(ks[:, :, lo:lo + n])
+            self._vc = self._vc.at[:, :, page, :n].set(vs[:, :, lo:lo + n])
+        tok = int(jnp.argmax(logits[t - 1]))
+        self._last_tok[slot] = tok
+        return slot, tok
+
+    # -- decode ------------------------------------------------------------
+
+    def step(self, slots) -> dict[int, int]:
+        """One greedy token for every slot in ``slots``; appends each
+        new token's KV to its pages.  Batch shape is always
+        ``max_seqs`` — absent slots ride as masked lanes."""
+        import jax.numpy as jnp
+
+        if not slots:
+            return {}
+        cfg = self.config
+        bsz = cfg.max_seqs
+        tokens = np.zeros(bsz, np.int32)
+        positions = np.zeros(bsz, np.int32)
+        dest_page = np.full(bsz, self._sink, np.int32)
+        dest_off = np.zeros(bsz, np.int32)
+        page_rows = np.full((bsz, cfg.max_pages_per_seq), self._sink,
+                            np.int32)
+        lengths = np.ones(bsz, np.int32)
+        order = sorted(slots)
+        for slot in order:
+            self.pages.append(slot, 1)      # the token decoded this step
+            page, off = self.pages.last_position(slot)
+            tokens[slot] = self._last_tok[slot]
+            positions[slot] = self.pages.length(slot) - 1
+            dest_page[slot] = page
+            dest_off[slot] = off
+            lengths[slot] = self.pages.length(slot)
+            row = self.pages.pages_of(slot)
+            page_rows[slot, :len(row)] = row
+        nxt, self._kc, self._vc = self._decode(
+            self.params, self._kc, self._vc, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(dest_page),
+            jnp.asarray(dest_off), jnp.asarray(page_rows),
+            jnp.asarray(lengths))
+        nxt = np.asarray(nxt)
+        out = {}
+        for slot in order:
+            self._last_tok[slot] = nxt[slot]
+            out[slot] = int(nxt[slot])
+        return out
+
+    def finish(self, slot: int) -> None:
+        self.pages.close(slot)
+
+    # -- introspection -----------------------------------------------------
+
+    def kv_bytes_per_token(self) -> int:
+        """Modeled KV footprint of one token across all layers (the
+        bench artifact's capacity-planning number)."""
+        return (2 * self.n_layers * self.config.n_heads * self.head_dim
+                * self._kc.dtype.itemsize)
+
+    def required_pages(self, budget_tokens: int) -> int:
+        return pages_for(budget_tokens, self.config.page_size)

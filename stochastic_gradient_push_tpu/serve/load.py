@@ -1,0 +1,184 @@
+"""Checkpoint ingest: a gossip run's per-rank shards → one serving tree.
+
+SGP's deployable artifact is not any single rank's parameters but the
+push-sum consensus ``x̄ = Σᵢ paramsᵢ / Σᵢ ps_weightᵢ`` — the quantity
+whose loss the convergence bounds control, and exactly the collapse
+``supervise.reshard.reshard_state`` already computes at restart
+boundaries.  Serving is that same transform pointed at a decode mesh:
+
+* torn sets are rejected (:class:`TornCheckpointError` propagates);
+* in-flight overlap FIFOs are folded into the consensus (mass counted
+  exactly once);
+* error-feedback residuals are dropped with the documented bounded
+  forfeit (pending quantization correction, not network mass).
+
+:func:`load_consensus` returns the ingested params **bit-identical** to
+``reshard_state(state, world, 1)["params"]`` row 0 — the ingest test
+holds that equality.  :func:`shard_params_for_decode` then places the
+tree onto a decode mesh via regex partition rules (SNIPPETS.md [3]
+idiom): attention/MLP kernels shard their head/ff dimension over the
+``model`` axis, everything else replicates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+import numpy as np
+
+from ..supervise.reshard import (_in_flight_slots, _map_leaves,
+                                 _rank_files, load_world_checkpoint,
+                                 reshard_state)
+
+__all__ = ["ConsensusIngestError", "IngestInfo", "available_worlds",
+           "load_consensus", "decode_partition_rules",
+           "match_partition_rules", "shard_params_for_decode"]
+
+
+class ConsensusIngestError(RuntimeError):
+    """No checkpoint set that serving can ingest (empty directory, or a
+    requested world with no files)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestInfo:
+    """Provenance of one consensus ingest, stamped into serve telemetry
+    and the bench artifact."""
+
+    world: int
+    files: tuple[str, ...]
+    step: int | None            # training meta step, when carried
+    in_flight_folded: int       # overlap FIFO slots folded into Σx/Σw
+    ef_forfeited: bool          # nonzero EF residual dropped (bounded)
+    plan: dict | None           # the run's schedule, when carried
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["files"] = [os.path.basename(p) for p in self.files]
+        d["plan"] = bool(self.plan)
+        return d
+
+
+def available_worlds(directory: str, tag: str = "") -> list[int]:
+    """World sizes with a checkpoint set on disk, newest set first."""
+    sets = _rank_files(directory, tag)
+    return sorted(
+        sets, reverse=True,
+        key=lambda w: max(os.path.getmtime(p) for _, p in sets[w]))
+
+
+def load_consensus(directory: str, tag: str = "",
+                   world: int | None = None):
+    """Ingest one checkpoint set into a single inference params tree.
+
+    Returns ``(params, meta, info)``: ``params`` is the numpy pytree of
+    the consensus model (bit-identical to the reshard collapse at
+    ``new_world=1``), ``meta`` the set's carried metadata (possibly
+    stripped — ``plan``/``health`` are optional on the serve path), and
+    ``info`` an :class:`IngestInfo`.  ``world=None`` picks the newest
+    set on disk; torn sets raise :class:`TornCheckpointError`.
+    """
+    if world is None:
+        worlds = available_worlds(directory, tag)
+        if not worlds:
+            raise ConsensusIngestError(
+                f"no {tag}checkpoint_r*_n*.ckpt under {directory}")
+        world = worlds[0]
+    state, meta, paths = load_world_checkpoint(directory, tag, world)
+    in_flight = len(_in_flight_slots(state))
+    ef = state.get("gossip", {}).get("ef_residual")
+    ef_forfeited = bool(ef is not None
+                        and np.any(np.asarray(ef, np.float64) != 0.0))
+    collapsed = reshard_state(state, world, 1)
+    params = _map_leaves(
+        collapsed["params"],
+        lambda path, leaf: None if leaf is None else np.asarray(leaf)[0])
+    step = meta.get("step")
+    info = IngestInfo(
+        world=world, files=tuple(paths),
+        step=None if step is None else int(step),
+        in_flight_folded=in_flight, ef_forfeited=ef_forfeited,
+        plan=meta.get("plan"))
+    return params, meta, info
+
+
+# -- decode-mesh placement ---------------------------------------------------
+
+
+def decode_partition_rules(axis: str | None = None):
+    """Regex name → PartitionSpec rules for the TransformerLM tree on a
+    1-D decode mesh: q/k/v/up/lm_head shard their output (head / ff /
+    vocab) dimension, o/down shard their input dimension so the pair
+    stays a contraction over the model axis; norms, biases and the
+    embedding replicate.  First match wins; the catch-all replicates
+    anything a future model adds."""
+    from jax.sharding import PartitionSpec as P
+
+    from .paged_attention import MODEL_AXIS
+
+    if axis is None:
+        axis = MODEL_AXIS
+    return (
+        (r"attn/(q|k|v)/kernel$", P(None, axis)),
+        (r"attn/o/kernel$", P(axis, None)),
+        (r"up/kernel$", P(None, axis)),
+        (r"down/kernel$", P(axis, None)),
+        (r"lm_head/kernel$", P(None, axis)),
+        (r".*", P()),
+    )
+
+
+def match_partition_rules(rules, params) -> dict:
+    """Map every leaf to the PartitionSpec of the first rule whose
+    regex searches its ``/``-joined path (SNIPPETS.md [3] idiom).
+    Scalar leaves pass through replicated without consulting the rules;
+    a leaf no rule matches is a typed error, not a silent replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    def leaf_fn(path, leaf):
+        if leaf is None:
+            return None
+        name = "/".join(path)
+        if np.ndim(leaf) == 0 or np.size(leaf) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ConsensusIngestError(
+            f"no partition rule matches param '{name}'")
+
+    return _map_leaves(params, leaf_fn)
+
+
+def shard_params_for_decode(params, mesh, rules=None):
+    """Place the ingested tree onto the decode mesh: each leaf becomes
+    a jax array with the NamedSharding its rule names.  Dimensions that
+    don't divide the axis fall back to replication (tiny models on wide
+    meshes must still serve)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = decode_partition_rules() if rules is None else rules
+    specs = match_partition_rules(rules, params)
+
+    def place(path, leaf):
+        if leaf is None:
+            return None
+        spec = _leaf_spec(specs, path)
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            if np.shape(leaf)[dim] % mesh.shape[axis]:
+                spec = P()
+                break
+        return jax.device_put(np.asarray(leaf), NamedSharding(mesh, spec))
+
+    return _map_leaves(params, place)
+
+
+def _leaf_spec(specs: dict, path: tuple):
+    for k in path:
+        specs = specs[k]
+    return specs

@@ -49,8 +49,9 @@ import typing as tp
 
 import numpy as np
 
-__all__ = ["TornCheckpointError", "ReshardReport", "load_world_checkpoint",
-           "consensus_mean", "reshard_state", "reshard_checkpoints",
+__all__ = ["TornCheckpointError", "CheckpointMetaError", "ReshardReport",
+           "load_world_checkpoint", "consensus_mean", "meta_key",
+           "reshard_state", "reshard_checkpoints",
            "maybe_cross_world_reshard", "gc_stale_tmp"]
 
 _CKPT_RE = re.compile(r"^checkpoint_r(\d+)_n(\d+)\.ckpt$")
@@ -68,6 +69,41 @@ class TornCheckpointError(RuntimeError):
     """A checkpoint set that does not assemble to its full world —
     missing rank files or row counts that don't add up (e.g. half the
     per-process files of a preempted save)."""
+
+
+class CheckpointMetaError(RuntimeError):
+    """Checkpoint metadata that cannot carry the requested resume —
+    a meta payload that is not a mapping, or a required key that a
+    hand-copied / serve-time shard set simply does not have.  Carries
+    ``key`` (the missing key, or None for a malformed payload) so
+    callers can report exactly what the set lacks instead of a bare
+    ``KeyError``/``TypeError``."""
+
+    def __init__(self, message: str, key: str | None = None):
+        super().__init__(message)
+        self.key = key
+
+
+def meta_key(meta: dict, key: str, context: str = ""):
+    """Fetch a *required* checkpoint-meta key with a typed error.
+
+    Training writes rich meta (``plan``, ``health``, counters), but the
+    consensus-collapse path must also ingest hand-copied shard sets
+    whose meta carries none of that — so optional keys are read with
+    ``meta.get`` and the genuinely required ones go through here, which
+    names the missing key (:class:`CheckpointMetaError`) instead of
+    surfacing a ``KeyError`` from deep inside the collapse."""
+    if not isinstance(meta, dict):
+        raise CheckpointMetaError(
+            f"checkpoint meta must be a mapping, got "
+            f"{type(meta).__name__}{f' ({context})' if context else ''}")
+    if key not in meta:
+        have = ", ".join(sorted(map(str, meta))) or "<empty>"
+        raise CheckpointMetaError(
+            f"checkpoint meta lacks required key '{key}'"
+            f"{f' ({context})' if context else ''}; present: {have}",
+            key=key)
+    return meta[key]
 
 
 def _walk(tree: tp.Any, path: tuple = ()):
@@ -165,8 +201,21 @@ def load_world_checkpoint(directory: str, tag: str, world: int
             raise TornCheckpointError(
                 f"{path}: not an atomic state+meta checkpoint (legacy "
                 "two-file layout is not reshardable)")
+        meta = raw["meta"]
+        # hand-copied / serve-time shard sets may carry a stripped meta
+        # (None, or missing plan/health/counters entirely): tolerate the
+        # empty payload here — required keys are fetched downstream via
+        # meta_key, which names what's missing — but reject payloads
+        # that aren't a mapping at all with a typed error instead of
+        # letting dict(meta) die as a TypeError mid-reshard
+        if meta is None:
+            meta = {}
+        elif not isinstance(meta, dict):
+            raise CheckpointMetaError(
+                f"{path}: checkpoint meta must be a mapping or None, "
+                f"got {type(meta).__name__}")
         states.append(raw["state"])
-        metas.append((os.path.getmtime(path), raw["meta"]))
+        metas.append((os.path.getmtime(path), meta))
     rows = [int(_ps_weight(s).shape[0]) for s in states]
     if sum(rows) != world:
         raise TornCheckpointError(

@@ -47,6 +47,8 @@ EVENT_KINDS = frozenset({
     "relaunch",     # one generation boundary: reshard + replan + respawn
     "rendezvous",   # fleet host<->coordinator barrier protocol message
     "fleet",        # pod-coordinator decision (assign/go/complete/halt)
+    "serve",        # serving-stack lifecycle (reject/summary; serve/)
+    "request",      # one completed serve request (typed-only; serve/)
 })
 
 SEVERITIES = ("info", "warning", "error")
@@ -60,6 +62,7 @@ LEGACY_PREFIXES = {
     "supervisor": "gossip supervisor",
     "rendezvous": "gossip rendezvous",
     "fleet": "gossip fleet",
+    "serve": "gossip serve",
 }
 
 

@@ -34,7 +34,7 @@ __all__ = ["SpanTracer", "NullTracer", "NULL_TRACER", "SPAN_PHASES"]
 # the span taxonomy: every event lands on one of these phase tracks
 # (Chrome-trace tid); obsreport groups its per-phase totals by them
 SPAN_PHASES = ("data", "step", "gossip", "global_avg", "checkpoint",
-               "eval", "recovery", "bench")
+               "eval", "recovery", "bench", "serve", "request")
 
 
 class _NullSpan:

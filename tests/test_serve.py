@@ -1,0 +1,555 @@
+"""serve/: page-table oracle, paged-decode parity, engine-vs-model
+parity, continuous-batching invariants, consensus ingest, and the
+decode-fleet child's supervisor contracts.
+
+The two load-bearing equalities are pinned here:
+
+* the ingested serving params are BIT-equal to the reshard collapse
+  (``reshard_state(state, world, 1)`` row 0) — serving deploys exactly
+  the consensus the restart boundary would compute;
+* the paged decode path (Pallas interpret kernel, sharded or not, and
+  the whole greedy engine) matches the dense ``TransformerLM`` oracle.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.serve.pages import (
+    PageCapacityError,
+    PageTable,
+    pages_for,
+)
+from stochastic_gradient_push_tpu.serve.scheduler import (
+    AdmissionError,
+    ContinuousBatcher,
+    Request,
+)
+
+# -- page table (pure python: no jax anywhere in this section) --------------
+
+
+class TestPageTable:
+    def test_pages_for_is_ceil_div(self):
+        assert pages_for(1, 8) == 1
+        assert pages_for(8, 8) == 1
+        assert pages_for(9, 8) == 2
+        assert pages_for(0, 8) == 0
+
+    def test_open_reserves_full_budget_up_front(self):
+        t = PageTable(num_pages=8, page_size=4, max_seqs=4)
+        slot = t.open(budget_tokens=10)        # 3 pages reserved
+        assert t.reserved_pages == 3 and t.used_pages == 0
+        assert t.available_pages == 5
+        t.append(slot, 10)
+        # the reservation converted into real pages, none left over
+        assert t.used_pages == 3 and t.reserved_pages == 0
+        t.close(slot)
+        assert t.free_pages == 8
+
+    def test_pages_hand_out_ascending_and_recycle(self):
+        t = PageTable(num_pages=4, page_size=2, max_seqs=4)
+        a = t.open(4)
+        t.append(a, 4)
+        assert t.pages_of(a) == (0, 1)
+        b = t.open(4)
+        t.append(b, 4)
+        assert t.pages_of(b) == (2, 3)
+        t.close(a)                     # frees 0, 1
+        c = t.open(3)
+        t.append(c, 3)
+        assert set(t.pages_of(c)) <= {0, 1}   # freed pages reused
+        t.close(b)
+        t.close(c)
+        t.assert_quiescent()
+
+    def test_capacity_errors_are_typed(self):
+        t = PageTable(num_pages=2, page_size=4, max_seqs=1)
+        with pytest.raises(PageCapacityError):
+            t.open(9)                  # 3 pages > 2 in the pool
+        slot = t.open(8)
+        with pytest.raises(PageCapacityError):
+            t.open(1)                  # max_seqs exhausted
+        t.append(slot, 8)
+        with pytest.raises(PageCapacityError):
+            t.append(slot, 1)          # past the reserved budget
+        t.close(slot)
+
+    def test_reservation_blocks_other_admissions(self):
+        # an admitted-but-short sequence still owns its whole budget:
+        # available_pages is free minus reserved, so a second open that
+        # would overlap the reservation is refused
+        t = PageTable(num_pages=4, page_size=4, max_seqs=4)
+        s = t.open(16)                 # reserves all 4 pages
+        t.append(s, 2)                 # only 1 page materialized
+        assert t.used_pages == 1 and t.available_pages == 0
+        assert not t.can_fit(1)
+        with pytest.raises(PageCapacityError):
+            t.open(1)
+        t.close(s)
+        assert t.can_fit(16)
+
+    def test_last_position_and_page_index_array(self):
+        t = PageTable(num_pages=4, page_size=4, max_seqs=2)
+        s = t.open(10)
+        t.append(s, 5)
+        assert t.length(s) == 5
+        assert t.last_position(s) == (t.pages_of(s)[1], 0)
+        rows = t.page_index_array([s], max_pages=3)
+        assert rows.shape == (1, 3) and rows.dtype == np.int32
+        assert tuple(rows[0, :2]) == t.pages_of(s)
+        t.close(s)
+
+    def test_quiescence_names_leaks(self):
+        t = PageTable(num_pages=4, page_size=4, max_seqs=2)
+        t.open(4)
+        with pytest.raises(AssertionError, match="live sequences"):
+            t.assert_quiescent()
+
+
+# -- continuous batching (synthetic engine: still no accelerator) -----------
+
+
+def _synthetic_engine(num_pages=32, max_seqs=4, page_size=4,
+                      max_pages_per_seq=8):
+    from stochastic_gradient_push_tpu.serve.bench import SyntheticEngine
+    from stochastic_gradient_push_tpu.serve.engine import ServeConfig
+
+    return SyntheticEngine(ServeConfig(
+        n_heads=1, page_size=page_size, num_pages=num_pages,
+        max_seqs=max_seqs, max_pages_per_seq=max_pages_per_seq))
+
+
+class TestContinuousBatching:
+    def test_no_slot_leak_over_200_requests(self):
+        from stochastic_gradient_push_tpu.serve.bench import (
+            run_bench, synthetic_requests)
+
+        engine = _synthetic_engine()
+        requests = synthetic_requests(200, seed=3)
+        metrics, completions = run_bench(engine, requests)
+        assert metrics["requests"] == 200
+        assert len(completions) == 200
+        # run_bench already asserted quiescence; re-assert for the test
+        engine.pages.assert_quiescent()
+        assert engine.pages.free_pages == engine.pages.num_pages
+        # every request got exactly its token budget
+        by_rid = {r.rid: r for r in requests}
+        for c in completions:
+            assert len(c.tokens) == by_rid[c.rid].max_new_tokens
+
+    def test_permanent_rejection_is_typed_and_counted(self):
+        from stochastic_gradient_push_tpu.telemetry import (
+            MemorySink, TelemetryRegistry)
+
+        mem = MemorySink()
+        batcher = ContinuousBatcher(
+            _synthetic_engine(max_pages_per_seq=2),
+            registry=TelemetryRegistry(sinks=[mem]))
+        with pytest.raises(AdmissionError):
+            batcher.submit(Request(rid=0, prompt=(1,) * 10,
+                                   max_new_tokens=5))   # 15 > 8 window
+        assert batcher.rejected == 1 and batcher.pending == 0
+        [ev] = mem.by_kind("serve")
+        assert ev["data"]["phase"] == "reject"
+        assert ev["severity"] == "warning"
+
+    def test_backpressure_queues_fifo_and_drains(self):
+        from stochastic_gradient_push_tpu.telemetry import (
+            MemorySink, TelemetryRegistry)
+
+        mem = MemorySink()
+        # one slot, tiny pool: everything must serialize through it
+        engine = _synthetic_engine(num_pages=4, max_seqs=1,
+                                   max_pages_per_seq=4)
+        batcher = ContinuousBatcher(
+            engine, registry=TelemetryRegistry(sinks=[mem]))
+        for rid in range(6):
+            batcher.submit(Request(rid=rid, prompt=(1, 2, 3),
+                                   max_new_tokens=3))
+        completions = batcher.drain()
+        assert [c.rid for c in completions] == list(range(6))  # FIFO
+        assert len(mem.by_kind("request")) == 6
+        assert batcher.peak_occupancy > 0
+
+    def test_max_new_one_completes_at_prefill(self):
+        batcher = ContinuousBatcher(_synthetic_engine())
+        batcher.submit(Request(rid=7, prompt=(4, 5), max_new_tokens=1))
+        [done] = batcher.step()
+        assert done.rid == 7 and len(done.tokens) == 1
+        batcher.engine.pages.assert_quiescent()
+
+
+# -- paged attention parity -------------------------------------------------
+
+
+def _paged_case(seed=0, b=4, h=8, kv_pages=9, page_size=4, d=8, np_=6):
+    r = np.random.default_rng(seed)
+    q = r.standard_normal((b, h, d)).astype(np.float32)
+    kp = r.standard_normal((h, kv_pages, page_size, d)).astype(np.float32)
+    vp = r.standard_normal((h, kv_pages, page_size, d)).astype(np.float32)
+    pi = r.integers(0, kv_pages, size=(b, np_)).astype(np.int32)
+    lengths = r.integers(1, np_ * page_size + 1, size=b).astype(np.int32)
+    return q, kp, vp, pi, lengths
+
+
+class TestPagedAttention:
+    def test_interpret_kernel_matches_dense_reference(self):
+        from stochastic_gradient_push_tpu.serve.paged_attention import (
+            paged_attention_decode, paged_attention_reference)
+
+        q, kp, vp, pi, lengths = _paged_case(seed=1)
+        out = paged_attention_decode(q, kp, vp, pi, lengths,
+                                     use_pallas=True, interpret=True)
+        ref = paged_attention_reference(q, kp, vp, pi, lengths)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-6)
+
+    def test_jnp_fallback_matches_dense_reference(self):
+        from stochastic_gradient_push_tpu.serve.paged_attention import (
+            paged_attention_decode, paged_attention_reference)
+
+        q, kp, vp, pi, lengths = _paged_case(seed=2)
+        out = paged_attention_decode(q, kp, vp, pi, lengths,
+                                     use_pallas=False)
+        ref = paged_attention_reference(q, kp, vp, pi, lengths)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-6)
+
+    def test_length_one_attends_to_exactly_one_token(self):
+        # q_len == 1, kv length == 1: the output IS v at the first slot
+        from stochastic_gradient_push_tpu.serve.paged_attention import (
+            paged_attention_decode)
+
+        q, kp, vp, pi, _ = _paged_case(seed=3, b=2, np_=2)
+        lengths = np.ones(2, np.int32)
+        out = np.asarray(paged_attention_decode(
+            q, kp, vp, pi, lengths, use_pallas=True, interpret=True))
+        want = np.stack([vp[:, pi[i, 0], 0] for i in range(2)])
+        np.testing.assert_allclose(out, want, atol=2e-6)
+
+    def test_sharded_decode_matches_reference_on_model_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from stochastic_gradient_push_tpu.serve.paged_attention import (
+            paged_attention_reference, sharded_paged_decode)
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+        q, kp, vp, pi, lengths = _paged_case(seed=4)
+        out = sharded_paged_decode(mesh, q, kp, vp, pi, lengths,
+                                   use_pallas=True, interpret=True)
+        ref = paged_attention_reference(q, kp, vp, pi, lengths)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-6)
+
+
+# -- engine vs the dense model ----------------------------------------------
+
+
+def _tiny_lm(seed=0):
+    import jax
+
+    from stochastic_gradient_push_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=48, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        max_len=32, attn_impl="full"))
+    variables = model.init(jax.random.PRNGKey(seed),
+                           np.zeros((1, 8), np.int32))
+    return model, variables["params"]
+
+
+def _dense_greedy(model, params, prompt, n_new):
+    import jax
+    import jax.numpy as jnp
+
+    pjax = jax.tree.map(jnp.asarray, params)
+    seq, out = list(prompt), []
+    for _ in range(n_new):
+        logits = model.apply({"params": pjax},
+                             jnp.asarray([seq], jnp.int32))
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+class TestLMEngine:
+    def test_greedy_decode_matches_dense_model(self):
+        from stochastic_gradient_push_tpu.serve.engine import (
+            LMEngine, ServeConfig)
+
+        model, params = _tiny_lm()
+        engine = LMEngine(params, ServeConfig(
+            n_heads=2, page_size=4, num_pages=16, max_seqs=2,
+            max_pages_per_seq=4))
+        prompt, n_new = [5, 11, 3], 5
+        slot, tok = engine.start(list(prompt), len(prompt) + n_new)
+        got = [tok]
+        while len(got) < n_new:
+            got.append(engine.step([slot])[slot])
+        engine.finish(slot)
+        engine.pages.assert_quiescent()
+        assert got == _dense_greedy(model, params, prompt, n_new)
+
+    def test_concurrent_slots_do_not_cross_talk(self):
+        # two interleaved sequences decode exactly what each would
+        # decode alone — the page table isolates their KV
+        from stochastic_gradient_push_tpu.serve.engine import (
+            LMEngine, ServeConfig)
+
+        model, params = _tiny_lm(seed=1)
+        engine = LMEngine(params, ServeConfig(
+            n_heads=2, page_size=4, num_pages=16, max_seqs=2,
+            max_pages_per_seq=4))
+        pa, pb, n_new = [7, 2, 9, 4], [30, 1], 4
+        sa, ta = engine.start(list(pa), len(pa) + n_new)
+        sb, tb = engine.start(list(pb), len(pb) + n_new)
+        ga, gb = [ta], [tb]
+        while len(ga) < n_new:
+            step = engine.step([sa, sb])
+            ga.append(step[sa])
+            gb.append(step[sb])
+        engine.finish(sa)
+        engine.finish(sb)
+        engine.pages.assert_quiescent()
+        assert ga == _dense_greedy(model, params, pa, n_new)
+        assert gb == _dense_greedy(model, params, pb, n_new)
+
+    def test_kv_bytes_per_token_is_modeled(self):
+        from stochastic_gradient_push_tpu.serve.engine import (
+            LMEngine, ServeConfig)
+
+        _, params = _tiny_lm()
+        engine = LMEngine(params, ServeConfig(n_heads=2))
+        # 2 layers * 2 heads * head_dim 8 * 4 bytes, k and v
+        assert engine.kv_bytes_per_token() == 2 * 2 * 2 * 8 * 4
+
+
+# -- consensus ingest -------------------------------------------------------
+
+
+def _save_ckpt(path, state, meta, raw_meta=False):
+    import flax.serialization
+
+    if not raw_meta:
+        meta = json.loads(json.dumps(meta, default=float))
+    with open(path, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(
+            {"state": state, "meta": meta}))
+
+
+def _world_state(world, rows, seed):
+    r = np.random.default_rng(seed)
+    return {
+        "params": {"w": r.standard_normal((rows, 6)).astype(np.float32)},
+        "gossip": {
+            "ps_weight": r.uniform(0.5, 2.0, rows).astype(np.float32),
+            "phase": np.full(rows, 3, np.int32)},
+    }
+
+
+class TestConsensusIngest:
+    def _write_world(self, d, world=4, procs=2, tag=""):
+        rows = world // procs
+        for p in range(procs):
+            _save_ckpt(
+                os.path.join(d, f"{tag}checkpoint_r{p}_n{world}.ckpt"),
+                _world_state(world, rows, seed=p),
+                {"step": 5, "rows": rows, "process_id": p,
+                 "num_processes": procs, "world": world})
+
+    def test_ingest_bit_equals_reshard_collapse(self, tmp_path):
+        from stochastic_gradient_push_tpu.serve.load import (
+            load_consensus)
+        from stochastic_gradient_push_tpu.supervise.reshard import (
+            load_world_checkpoint, reshard_state)
+
+        d = str(tmp_path)
+        self._write_world(d)
+        params, _, info = load_consensus(d)
+        state, _, _ = load_world_checkpoint(d, "", 4)
+        want = reshard_state(state, 4, 1)["params"]["w"][0]
+        assert np.array_equal(params["w"], want)   # BIT equality
+        assert info.world == 4 and info.step == 5
+        assert len(info.files) == 2
+
+    def test_newest_world_wins(self, tmp_path):
+        from stochastic_gradient_push_tpu.serve.load import (
+            available_worlds, load_consensus)
+
+        d = str(tmp_path)
+        self._write_world(d, world=8, procs=2)
+        time.sleep(0.02)
+        self._write_world(d, world=4, procs=2)
+        os.utime(os.path.join(d, "checkpoint_r0_n4.ckpt"))
+        assert available_worlds(d)[0] == 4
+        assert load_consensus(d)[2].world == 4
+        assert load_consensus(d, world=8)[2].world == 8
+
+    def test_torn_set_rejected(self, tmp_path):
+        from stochastic_gradient_push_tpu.serve.load import (
+            load_consensus)
+        from stochastic_gradient_push_tpu.supervise.reshard import (
+            TornCheckpointError)
+
+        d = str(tmp_path)
+        self._write_world(d)
+        os.unlink(os.path.join(d, "checkpoint_r1_n4.ckpt"))
+        with pytest.raises(TornCheckpointError):
+            load_consensus(d)
+
+    def test_empty_directory_is_typed(self, tmp_path):
+        from stochastic_gradient_push_tpu.serve.load import (
+            ConsensusIngestError, load_consensus)
+
+        with pytest.raises(ConsensusIngestError):
+            load_consensus(str(tmp_path))
+
+    def test_partition_rules_cover_the_lm_tree(self):
+        from stochastic_gradient_push_tpu.serve.load import (
+            decode_partition_rules, match_partition_rules)
+
+        _, params = _tiny_lm()
+        params = {k: v for k, v in params.items()}
+        specs = match_partition_rules(decode_partition_rules(), params)
+        qspec = specs["block_0"]["attn"]["q"]["kernel"]
+        ospec = specs["block_0"]["attn"]["o"]["kernel"]
+        assert qspec == (None, "model") and ospec == ("model", None)
+        assert specs["embed"]["embedding"] == ()      # replicated
+
+
+class TestMetaBugfix:
+    """Checkpoints whose meta lacks plan/health (or is None) must
+    reshard and ingest; malformed meta fails typed, not as KeyError."""
+
+    def test_none_meta_tolerated(self, tmp_path):
+        from stochastic_gradient_push_tpu.serve.load import (
+            load_consensus)
+        from stochastic_gradient_push_tpu.supervise.reshard import (
+            load_world_checkpoint)
+
+        d = str(tmp_path)
+        _save_ckpt(os.path.join(d, "checkpoint_r0_n2.ckpt"),
+                   _world_state(2, 2, seed=0), None, raw_meta=True)
+        _, meta, _ = load_world_checkpoint(d, "", 2)
+        assert meta == {}
+        params, _, info = load_consensus(d)
+        assert info.step is None and params["w"].shape == (6,)
+
+    def test_non_dict_meta_is_typed(self, tmp_path):
+        from stochastic_gradient_push_tpu.supervise.reshard import (
+            CheckpointMetaError, load_world_checkpoint)
+
+        d = str(tmp_path)
+        _save_ckpt(os.path.join(d, "checkpoint_r0_n2.ckpt"),
+                   _world_state(2, 2, seed=0), ["not", "a", "dict"],
+                   raw_meta=True)
+        with pytest.raises(CheckpointMetaError, match="mapping"):
+            load_world_checkpoint(d, "", 2)
+
+    def test_meta_key_names_whats_missing(self):
+        from stochastic_gradient_push_tpu.supervise.reshard import (
+            CheckpointMetaError, meta_key)
+
+        assert meta_key({"plan": 1}, "plan") == 1
+        with pytest.raises(CheckpointMetaError, match="'plan'") as ei:
+            meta_key({"step": 3}, "plan", context="resume")
+        assert ei.value.key == "plan"
+        with pytest.raises(CheckpointMetaError):
+            meta_key("nope", "plan")
+
+    def test_stripped_meta_reshards(self, tmp_path):
+        # the serve-time shape: no plan, no health, no counters — the
+        # cross-world reshard must still go through
+        from stochastic_gradient_push_tpu.supervise.reshard import (
+            reshard_checkpoints)
+
+        d = str(tmp_path)
+        _save_ckpt(os.path.join(d, "checkpoint_r0_n2.ckpt"),
+                   _world_state(2, 2, seed=0), {"serve": True})
+        report = reshard_checkpoints(d, "", 2, 1)
+        assert report.new_world == 1
+
+
+# -- the decode-fleet child -------------------------------------------------
+
+
+class TestDecodeChild:
+    def _spawn(self, ck, tr, steps=400, extra=()):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "stochastic_gradient_push_tpu.serve.child",
+             "--checkpoint_dir", ck, "--trace_dir", tr,
+             "--world_size", "4", "--num_processes", "2",
+             "--process_id", "0", "--rows", "2",
+             "--steps", str(steps), "--step_s", "0.02",
+             "--save_every", "5", "--seed", "3", *extra],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    def _wait_for_steps(self, events_path, timeout=60.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if os.path.exists(events_path):
+                with open(events_path) as f:
+                    if any('"step_stats"' in ln for ln in f):
+                        return
+            time.sleep(0.05)
+        raise AssertionError("child produced no step_stats heartbeat")
+
+    def test_drain_contract_sigusr1_saves_and_exits_75(self, tmp_path):
+        ck, tr = str(tmp_path / "ck"), str(tmp_path / "tr")
+        child = self._spawn(ck, tr)
+        try:
+            self._wait_for_steps(os.path.join(tr, "events.jsonl"))
+            child.send_signal(signal.SIGUSR1)
+            out, _ = child.communicate(timeout=60)
+        finally:
+            if child.poll() is None:
+                child.kill()
+        assert child.returncode == 75, out
+        # the reshardable checkpoint landed (this host's 2 rows of 4)
+        assert os.path.exists(os.path.join(ck, "checkpoint_r0_n4.ckpt"))
+        events = [json.loads(ln)
+                  for ln in open(os.path.join(tr, "events.jsonl"))]
+        kinds = {e["kind"] for e in events}
+        assert {"run_meta", "step_stats", "serve"} <= kinds
+        last_meta = [e for e in events if e["kind"] == "run_meta"][-1]
+        assert last_meta["data"]["exit_reason"] == "preempted"
+        assert last_meta["data"]["exit_code"] == 75
+        # the drain finished every in-flight request before exit
+        summary = [e for e in events if e["kind"] == "serve"][-1]
+        assert summary["data"]["phase"] == "summary"
+        assert summary["data"]["requests"] > 0
+
+    def test_clean_run_ingests_consensus_and_exits_zero(self, tmp_path):
+        from stochastic_gradient_push_tpu.serve.child import PARAM_DIM
+
+        ck, tr = str(tmp_path / "ck"), str(tmp_path / "tr")
+        os.makedirs(ck)
+        # a training world-4 set for the child to ingest
+        r = np.random.default_rng(0)
+        for p in range(2):
+            _save_ckpt(
+                os.path.join(ck, f"checkpoint_r{p}_n4.ckpt"),
+                {"params": {"w": r.standard_normal(
+                    (2, PARAM_DIM)).astype(np.float32)},
+                 "gossip": {"ps_weight": np.ones(2, np.float32),
+                            "phase": np.zeros(2, np.int32)}},
+                {"step": 1, "rows": 2, "process_id": p,
+                 "num_processes": 2})
+        child = self._spawn(ck, tr, steps=3)
+        out, _ = child.communicate(timeout=120)
+        assert child.returncode == 0, out
+        events = [json.loads(ln)
+                  for ln in open(os.path.join(tr, "events.jsonl"))]
+        meta0 = [e for e in events if e["kind"] == "run_meta"][0]
+        assert meta0["data"]["model_source"] == "consensus_n4"
+        assert [e for e in events if e["kind"] == "request"]

@@ -191,6 +191,39 @@ class TestRegistry:
             ("WARNING", 'gossip recovery: {"action": "global-average"}'),
         ]
 
+    def test_serve_kinds_in_closed_vocabulary(self):
+        """The serving stack's kinds are declared: `serve` renders a
+        legacy-style line, `request` is typed-only, and both have a
+        span-phase track for the tracer."""
+        from stochastic_gradient_push_tpu.telemetry import (
+            EVENT_KINDS, LEGACY_PREFIXES, SPAN_PHASES)
+
+        assert {"serve", "request"} <= EVENT_KINDS
+        assert LEGACY_PREFIXES["serve"] == "gossip serve"
+        assert "request" not in LEGACY_PREFIXES
+        assert "serve" in SPAN_PHASES and "request" in SPAN_PHASES
+        reg = TelemetryRegistry()
+        assert reg.emit("serve", {"phase": "summary"})["kind"] == "serve"
+        assert reg.emit("request", {"id": 1})["kind"] == "request"
+
+    def test_serve_compat_line_is_byte_stable(self):
+        """`gossip serve: {sorted json}` — the exact legacy line shape,
+        so grep pipelines keyed on the other `gossip <kind>:` prefixes
+        extend to serving unchanged; `request` events emit no line."""
+        log, h = _list_logger()
+        reg = TelemetryRegistry(sinks=[LoggerCompatSink(log)])
+        summary = {"tokens_per_sec": 12.5, "requests": 3,
+                   "phase": "summary"}
+        reg.emit("serve", summary)
+        reg.emit("request", {"id": 0, "latency_s": 0.25})  # typed-only
+        reg.emit("serve", {"phase": "reject", "id": 9},
+                 severity="warning")
+        assert h.lines == [
+            ("INFO", "gossip serve: "
+             + json.dumps(summary, sort_keys=True)),
+            ("WARNING", 'gossip serve: {"id": 9, "phase": "reject"}'),
+        ]
+
 
 # -- producer wiring -------------------------------------------------------
 
