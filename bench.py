@@ -82,7 +82,18 @@ byte lanes for a reference payload (default ResNet-50 f32).  No
 measurement: the priced cost model IS the artifact, and fitting it to
 real step time is the on-chip calibration item in ROADMAP.  With
 ``--selftest``, gates that synthesis beats the registry on both DCN
-cases (CI; knobs BENCH_SYNTH_BUDGET/PAYLOAD/OUT).
+cases (CI; knobs BENCH_SYNTH_BUDGET/PAYLOAD/OUT).  Each modeled row
+also carries a ``simulated`` block (sim/ exact engine on the priced
+fabric), and the world-48 case stamps the Spearman rank correlation
+between modeled priced cost and simulated seconds per consensus e-fold
+across the full candidate grid — gated at >= 0.8.
+
+Fourth mode — ``python bench.py --sim-scale``: consensus-vs-simulated-
+wall-clock curves at pod worlds (256/1024/4096 x ring/exponential/
+npeer-exponential) on the 16:1 DCN fabric, from the sim/ package's
+exact engine.  Artifact: artifacts/bench_sim_scale.json (knobs
+BENCH_SIM_TOPOLOGIES/WORLDS/STEPS/OUT).  With ``--selftest``, gates
+curve coverage and the exponential-beats-ring wall-clock ordering.
 """
 
 import json
@@ -885,6 +896,54 @@ def overlap_vs_sync_main(selftest: bool) -> int:
     return 0
 
 
+def _spearman(xs, ys) -> float:
+    """Spearman rank correlation with average ranks for ties."""
+    def ranks(v):
+        order = sorted(range(len(v)), key=lambda i: v[i])
+        r = [0.0] * len(v)
+        i = 0
+        while i < len(order):
+            j = i
+            while j + 1 < len(order) \
+                    and v[order[j + 1]] == v[order[i]]:
+                j += 1
+            for k in range(i, j + 1):
+                r[order[k]] = (i + j) / 2.0 + 1.0
+            i = j + 1
+        return r
+    rx, ry = ranks(list(xs)), ranks(list(ys))
+    mx, my = sum(rx) / len(rx), sum(ry) / len(ry)
+    num = sum((a - mx) * (b - my) for a, b in zip(rx, ry))
+    den = (sum((a - mx) ** 2 for a in rx)
+           * sum((b - my) ** 2 for b in ry)) ** 0.5
+    return num / den if den else 0.0
+
+
+def _sim_seconds_per_efold(schedule, fabric, steps: int = 64,
+                           seed: int = 1) -> dict:
+    """Simulated wall-clock per consensus e-fold: the sim/ engine runs
+    the exact schedule while the fabric model accumulates priced
+    seconds; the quotient is the empirical counterpart of the planner's
+    modeled ``priced_cost``."""
+    import math
+
+    from stochastic_gradient_push_tpu.sim import (consensus_curve,
+                                                  time_to_error)
+    curve = consensus_curve(schedule, steps, interconnect=fabric,
+                            seed=seed)
+    # clamp at the f64 noise floor: exact-averaging cycles bottom out
+    # around 1e-16 and would otherwise divide by ~0 e-folds
+    first = max(curve["error"][0], 1e-13)
+    last = max(curve["error"][-1], 1e-13)
+    efolds = math.log(first / last)
+    return {"sim_s_per_efold": (curve["time_s"][-1] / efolds
+                                if efolds > 1e-9 else None),
+            "sim_cycle_time_s": curve["cycle_time_s"],
+            "sim_final_error": curve["error"][-1],
+            "sim_time_to_1e-6_s": time_to_error(curve, 1e-6),
+            "sim_rounds": steps}
+
+
 def synth_vs_registry_main(selftest: bool) -> int:
     """--synth-vs-registry: stamp the synthesized schedule's modeled
     priced bytes and gap next to the best registry candidate's (see the
@@ -937,7 +996,9 @@ def synth_vs_registry_main(selftest: bool) -> int:
                "registry_best": {
                    **best_reg.to_dict(),
                    "modeled_bytes_per_round": round_bytes(reg_sched,
-                                                          fabric)}}
+                                                          fabric),
+                   "simulated": _sim_seconds_per_efold(reg_sched,
+                                                       fabric)}}
         if plan.topology == "synth":
             spec = plan.synth["spec"]
             ssched = build_schedule(SynthesizedGraph(world, spec=spec))
@@ -949,7 +1010,42 @@ def synth_vs_registry_main(selftest: bool) -> int:
                 "phases": [ph["kind"] for ph in spec["phases"]],
                 "fingerprint": spec_fingerprint(spec),
                 "evals": plan.synth["evals"],
-                "modeled_bytes_per_round": round_bytes(ssched, fabric)}
+                "modeled_bytes_per_round": round_bytes(ssched, fabric),
+                "simulated": _sim_seconds_per_efold(ssched, fabric)}
+        if world == 48 and fabric is not None:
+            # does the modeled per-round priced cost rank schedules the
+            # way simulated per-round wall-clock does?  This isolates
+            # the PRICING lane (bytes x fabric -> seconds; CommModel +
+            # cycle_cost vs the sim FabricModel are independent
+            # implementations over the same InterconnectModel); the
+            # RATE lane (gap -> rounds/e-fold) is verified separately
+            # by engine bit-exactness + SGPV, and its end-to-end
+            # residue is stamped per candidate as sim_s_per_efold for
+            # the on-chip calibration item
+            per_round_m, per_round_s = [], []
+            per_efold_m, per_efold_s = [], []
+            cand_rows = []
+            for c in regs:
+                sched_c = build_schedule(
+                    c.graph_class(world, peers_per_itr=c.ppi))
+                sim = _sim_seconds_per_efold(sched_c, fabric)
+                mrow = c.priced_cost / max(c.rounds_per_efold, 1e-12)
+                srow = (sim["sim_cycle_time_s"]
+                        / max(sched_c.num_phases, 1))
+                per_round_m.append(mrow)
+                per_round_s.append(srow)
+                if sim["sim_s_per_efold"] is not None:
+                    per_efold_m.append(c.priced_cost)
+                    per_efold_s.append(sim["sim_s_per_efold"])
+                cand_rows.append({"topology": c.topology, "ppi": c.ppi,
+                                  "priced_cost": c.priced_cost,
+                                  "priced_per_round": mrow,
+                                  "sim_s_per_round": srow, **sim})
+            row["candidate_correlation"] = {
+                "spearman": _spearman(per_round_m, per_round_s),
+                "spearman_per_efold": _spearman(per_efold_m,
+                                                per_efold_s),
+                "count": len(cand_rows), "candidates": cand_rows}
         cases.append(row)
 
     out = {"benchmark": "synth_vs_registry", "budget": budget,
@@ -972,6 +1068,12 @@ def synth_vs_registry_main(selftest: bool) -> int:
             failures.append(
                 f"world {row['world']} on the DCN-dominant fabric: "
                 "synthesis did not beat the registry")
+        corr = row.get("candidate_correlation")
+        if corr is not None and not corr["spearman"] >= 0.8:
+            failures.append(
+                f"world {row['world']}: modeled priced cost vs "
+                f"simulated wall-clock Spearman {corr['spearman']:.3f} "
+                f"< 0.8 over {corr['count']} candidates")
         if row["beats_registry"] and not (
                 row["synthesized"]["priced_cost"]
                 < row["registry_best"]["priced_cost"]):
@@ -992,6 +1094,75 @@ def synth_vs_registry_main(selftest: bool) -> int:
              for r in out["cases"]]
     print("synth-vs-registry selftest: OK (" + "; ".join(beats) + ")",
           flush=True)
+    return 0
+
+
+def sim_scale_main(selftest: bool) -> int:
+    """--sim-scale: consensus-vs-simulated-wall-clock curves at pod
+    worlds (256/1024/4096) for the core topology registry on the 16:1
+    DCN fabric — the scale regime no CI mesh can execute, produced by
+    the sim/ exact engine + priced fabric.  Artifact:
+    artifacts/bench_sim_scale.json."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from stochastic_gradient_push_tpu.planner import InterconnectModel
+    from stochastic_gradient_push_tpu.sim import sweep_curves
+    from stochastic_gradient_push_tpu.topology import (TOPOLOGY_NAMES,
+                                                       build_schedule)
+
+    topos = os.environ.get(
+        "BENCH_SIM_TOPOLOGIES",
+        "ring,exponential,npeer-exponential").split(",")
+    worlds = [int(w) for w in os.environ.get(
+        "BENCH_SIM_WORLDS", "256,1024,4096").split(",")]
+    steps = int(os.environ.get("BENCH_SIM_STEPS", "96"))
+    t0 = time.time()
+    rows = sweep_curves(
+        {name: (lambda w, _cls=TOPOLOGY_NAMES[name]:
+                build_schedule(_cls(w, peers_per_itr=1)))
+         for name in topos},
+        worlds, steps,
+        interconnect_for=lambda w: InterconnectModel(slice_size=32,
+                                                     dcn_cost=16.0),
+        eps=1e-6)
+    out = {"benchmark": "sim_scale", "steps": steps,
+           "fabric": {"slice_size": 32, "dcn_cost": 16.0},
+           "elapsed_s": round(time.time() - t0, 3), "curves": rows}
+    out_path = os.environ.get(
+        "BENCH_SIM_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "artifacts", "bench_sim_scale.json"))
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    for r in rows:
+        tte = r["time_to_eps"]
+        print(f"sim-scale: {r['topology']}-{r['world']}: final error "
+              f"{r['final_error']:.3e}, time-to-1e-6 "
+              f"{'unreached' if tte is None else f'{tte:.3e}s'}")
+    print(f"sim-scale: wrote {out_path} ({out['elapsed_s']}s)",
+          flush=True)
+    if not selftest:
+        return 0
+    failures = []
+    seen = {(r["topology"], r["world"]) for r in rows}
+    want = {(t, w) for t in topos for w in worlds}
+    if seen != want:
+        failures.append(f"missing curves: {sorted(want - seen)}")
+    for w in worlds:
+        exp = next(r for r in rows
+                   if r["topology"] == "exponential" and r["world"] == w)
+        ring = next(r for r in rows
+                    if r["topology"] == "ring" and r["world"] == w)
+        if exp["time_to_eps"] is None:
+            failures.append(f"exponential-{w} never reached 1e-6")
+        elif ring["time_to_eps"] is not None \
+                and exp["time_to_eps"] >= ring["time_to_eps"]:
+            failures.append(f"ring-{w} beat exponential-{w} to 1e-6")
+    if failures:
+        for msg in failures:
+            print(f"sim-scale selftest: FAIL — {msg}", file=sys.stderr)
+        return 1
+    print("sim-scale selftest: OK", flush=True)
     return 0
 
 
@@ -1349,5 +1520,7 @@ if __name__ == "__main__":
         sys.exit(overlap_vs_sync_main("--selftest" in sys.argv))
     elif "--synth-vs-registry" in sys.argv:
         sys.exit(synth_vs_registry_main("--selftest" in sys.argv))
+    elif "--sim-scale" in sys.argv:
+        sys.exit(sim_scale_main("--selftest" in sys.argv))
     else:
         main()

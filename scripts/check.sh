@@ -63,6 +63,14 @@ echo "== serve self-check (train -> consensus ingest -> paged-attention serving)
 python scripts/serve.py --selftest
 
 echo
+echo "== sim self-check (exact engine vs oracle, priced fabric, fleet at world 1024, grow 4->6) =="
+python scripts/sim.py --selftest
+
+echo
+echo "== sim-scale artifact (consensus-vs-wall-clock curves at 256/1024/4096) =="
+python bench.py --sim-scale --selftest
+
+echo
 echo "== tier-1 tests (CPU, not slow) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""sim — priced-fabric fleet simulator for gossip + supervision.
+
+Executes compiled gossip schedules EXACTLY (the engine's scatter is
+bit-identical to the dense mixing-matrix oracle) over thousands of
+ranks, prices every message on the planner's interconnect model, runs
+fault campaigns through the resilience grammar's mass-conserving masks,
+and drives the real supervise/ coordinator against simulated hosts.
+
+Usage:
+    # a consensus-vs-simulated-wall-clock curve on a sliced fabric:
+    python scripts/sim.py --topology exponential --world 1024 \\
+        --slice-size 256 --steps 200 --out curve.json
+
+    # a named fault campaign over the run:
+    python scripts/sim.py --world 1024 --slice-size 128 \\
+        --campaign kill-slice
+
+    # the CI gate: engine bit-exactness at world 256, priced
+    # ring-vs-exponential ordering, churn mass conservation, and the
+    # kill-slice / coordinator-loss / grow fleet scenarios against the
+    # real coordinator:
+    python scripts/sim.py --selftest
+
+Exit codes: 0 clean, 1 selftest failure.
+"""
+
+import os
+import signal
+import sys
+
+# die quietly when piped into `head` instead of tracebacking
+signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+
+# numpy-only simulator, but the fleet lane's checkpoint + planner
+# imports pull in jax; keep it on CPU for CI boxes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from stochastic_gradient_push_tpu.sim.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

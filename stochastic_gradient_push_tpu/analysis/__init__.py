@@ -43,6 +43,7 @@ _VERIFIER_EXPORTS = frozenset({
     "spectral_gap", "spectral_gap_cache_clear", "spectral_gap_cache_info",
     "spectral_gap_cache_limit", "schedule_fingerprint", "GapEntry",
     "is_unsupported_config", "DEFAULT_WORLD_SIZES",
+    "SPARSE_GAP_WORLD_MIN",
 })
 
 

@@ -128,6 +128,26 @@ def _selftest(world: int, floor: float) -> int:
           "hierarchical candidate does not minimize DCN volume per "
           "consensus e-fold among floor-clearing candidates")
 
+    # the same flip pinned at pod scale (world 1024, the sim/ regime):
+    # 16:1 DCN must crown the two-level graph, a uniform fabric must
+    # keep a flat winner.  The ring is excluded — its near-closed
+    # spectrum is the sparse-gap stress case, not a planner contender
+    # at this world — so the pin stays inside the CI budget
+    pod_allowed = ("exponential", "npeer-exponential", "linear",
+                   "hierarchical")
+    pod_fabric = InterconnectModel(slice_size=32, dcn_cost=16.0)
+    pod_dcn = score_candidates(1024, (1,), allowed=pod_allowed,
+                               interconnect=pod_fabric)
+    check(pod_dcn[0].topology == "hierarchical"
+          and pod_dcn[0].slice_size == 32,
+          f"16:1 DCN world-1024 ranking did not crown hierarchical "
+          f"(got {pod_dcn[0].topology})")
+    pod_uni = score_candidates(1024, (1,), allowed=pod_allowed)
+    check(pod_uni[0].slice_size is None
+          and pod_uni[0].topology != "hierarchical",
+          f"uniform world-1024 ranking picked a sliced topology "
+          f"(got {pod_uni[0].topology})")
+
     # schedule synthesizer: on a 16:1 DCN-dominant fabric the searched
     # hybrid psum/ppermute cycle must beat EVERY registry entry on
     # priced cost per consensus e-fold — at a non-power-of-two world

@@ -309,6 +309,23 @@ class FaultPlan:
 
     # -- verification helpers (host-side numpy, used by tests/chaos) ------
 
+    def host_tables(self, schedule: GossipSchedule, gossip_every: int = 1
+                    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """The numpy analogue of :meth:`build_masks` for host-side
+        executors (the fleet simulator): ``(keep, corrupt, horizon)``
+        with keep ``(horizon + num_phases, ppi, world)`` and corrupt
+        ``(horizon + num_phases, world)``.  Row selection contract is
+        :meth:`FaultMasks._row`: row ``t`` while ``t < horizon``, then
+        the per-phase steady-state row ``horizon + phase(t)``.  Compile
+        once per (plan, schedule); per-tick lookup is then one index."""
+        if gossip_every < 1:
+            raise ValueError("gossip_every must be >= 1")
+        self.validate(schedule.world_size)
+        horizon = self.horizon()
+        keep, corrupt = self._keep_corrupt_tables(schedule, horizon,
+                                                  gossip_every)
+        return keep, corrupt, horizon
+
     def effective_schedule(self, schedule: GossipSchedule, tick: int,
                            gossip_every: int = 1) -> GossipSchedule:
         """The faulted mixing tables at ``tick`` as a one-phase

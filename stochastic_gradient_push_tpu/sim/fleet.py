@@ -1,0 +1,406 @@
+"""The fleet lane: REAL coordinator, simulated hosts.
+
+``scripts/fleet.py --selftest`` proves the supervision stack on 3 real
+subprocesses; a pod farm is 8–64 hosts owning 1024–4096 ranks, and
+nothing at that scale fits in subprocesses on a CI box.  This module
+closes the gap with :class:`SimHost` — a thread that speaks the exact
+host-side wire protocol (:class:`~..supervise.coordinator.FleetMember`
+events into ``host{h}/supervisor.jsonl``, hostsim-format reshardable
+checkpoints, the drain-then-join barrier, concurrent
+``reshard_checkpoints`` of its assigned shard) against the *unmodified*
+:class:`~..supervise.coordinator.Coordinator`.  What is simulated is
+only the trainer; every line of rendezvous, exclusion, replan,
+assignment, and commit logic that runs here is the production code.
+
+Scenarios (:func:`run_sim_fleet`):
+
+* **whole-slice kill** — a victim host stops emitting mid-run (the
+  SIGKILL shape); the coordinator must detect silence, exclude it, and
+  drive exactly ONE coordinated shrink cycle;
+* **coordinator loss** — the coordinator starts ``down_s`` seconds
+  late: the host events queue in the stream files (tailers replay), and
+  recovery still produces exactly one cycle;
+* **grow-the-world** — a joiner host appears mid-run: its hello is a
+  join request, and the coordinator runs one n → n′ *upward* reshard
+  cycle in which every host — incumbent and joiner alike — restarts
+  from consensus-collapsed rows of the grown world (the exact network
+  mean, so the boundary drift is f32 cast error only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..supervise.coordinator import Coordinator, FleetMember, host_dir
+from ..supervise.reshard import (TornCheckpointError, consensus_mean,
+                                 load_world_checkpoint,
+                                 reshard_checkpoints)
+from ..telemetry import (COORDINATOR_EVENTS_FILE, JsonlSink,
+                         SUPERVISOR_EVENTS_FILE, TelemetryRegistry)
+
+__all__ = ["SimHost", "FleetReport", "run_sim_fleet"]
+
+PARAM_DIM = 16  # matches supervise/hostsim.py
+
+
+def _save_ckpt(path: str, state: dict, meta: dict) -> None:
+    """Atomic msgpack save in the reshardable layout (same hygiene as
+    hostsim: serialize, fsync, rename)."""
+    import flax.serialization
+
+    payload = flax.serialization.msgpack_serialize(
+        {"state": state, "meta": meta})
+    tmp = path + f".tmp.r{meta['process_id']}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class SimHost(threading.Thread):
+    """One simulated host: ``rows`` ranks of the gossip world, the full
+    member side of the coordination protocol, none of the accelerator.
+
+    ``join=True`` makes it a late joiner: it pre-drains the broadcast
+    tailer (which replays from byte 0), says hello as its join request,
+    and only starts training after the coordinator's go hands it a
+    consensus-initialized shard of the grown world."""
+
+    def __init__(self, fleet_dir: str, host: int, rows: int,
+                 rank_offset: int, world: int, *,
+                 checkpoint_dir: str | None = None, tag: str = "",
+                 steps: int = 20, save_every: int = 5,
+                 step_s: float = 0.005, seed: int = 0,
+                 alive_interval_s: float = 0.3,
+                 poll_s: float = 0.05, join: bool = False):
+        super().__init__(name=f"simhost{host}", daemon=True)
+        self.fleet_dir = fleet_dir
+        self.checkpoint_dir = checkpoint_dir or fleet_dir
+        self.tag = tag
+        self.host = int(host)
+        self.rows = int(rows)
+        self.rank_offset = int(rank_offset)
+        self.world = int(world)
+        self.steps = int(steps)
+        self.save_every = int(save_every)
+        self.step_s = float(step_s)
+        self.seed = int(seed)
+        self.poll_s = float(poll_s)
+        self.joiner = bool(join)
+        self.out_rank = int(host)
+        self.step = 0
+        self.generation = 0
+        self.relaunches = 0
+        self.exit_reason: str | None = None
+        self.kill_event = threading.Event()   # whole-slice SIGKILL
+        os.makedirs(host_dir(fleet_dir, host), exist_ok=True)
+        self._registry = TelemetryRegistry(rank=host, sinks=[
+            JsonlSink(os.path.join(host_dir(fleet_dir, host),
+                                   SUPERVISOR_EVENTS_FILE))])
+        self.member = FleetMember(fleet_dir, host, rows,
+                                  alive_interval_s=alive_interval_s)
+        self.member.bind(self._registry)
+        self._state: dict | None = None
+
+    # -- trainer ----------------------------------------------------------
+
+    def _init_state(self) -> dict:
+        w = np.stack([
+            np.random.default_rng(
+                self.seed * 100_003 + self.rank_offset + i)
+            .standard_normal(PARAM_DIM).astype(np.float32)
+            for i in range(self.rows)])
+        return {"params": {"w": w},
+                "gossip": {"ps_weight": np.ones(self.rows, np.float32),
+                           "phase": np.zeros(self.rows, np.int32)}}
+
+    def _ckpt_path(self, world: int | None = None) -> str:
+        return os.path.join(
+            self.checkpoint_dir,
+            f"{self.tag}checkpoint_r{self.out_rank}"
+            f"_n{world or self.world}.ckpt")
+
+    def _save(self) -> None:
+        _save_ckpt(self._ckpt_path(), self._state, {
+            "step": self.step, "world": self.world, "rows": self.rows,
+            "process_id": self.out_rank, "num_processes": 0,
+            "epoch": 0, "itr": self.step})
+
+    def _train_step(self) -> None:
+        rng = np.random.default_rng(
+            self.seed * 100_003 + (self.rank_offset << 20) + self.step)
+        w = self._state["params"]["w"]
+        self._state["params"]["w"] = (
+            w + 0.01 * rng.standard_normal(w.shape).astype(w.dtype))
+        self.step += 1
+
+    # -- protocol ---------------------------------------------------------
+
+    def _reshard_and_ack(self, data: dict, shard: dict) -> None:
+        report = None
+        try:
+            report = reshard_checkpoints(
+                self.checkpoint_dir, self.tag, data["prev_world"],
+                data["world"], out_rank=shard["out_rank"],
+                out_rows=shard["out_rows"], plan=data.get("plan"))
+        except (TornCheckpointError, ValueError):
+            pass
+        self.member.ack(data["round"], ok=report is not None,
+                        mean_drift=(report.mean_drift
+                                    if report is not None else None),
+                        out_rank=shard["out_rank"],
+                        out_rows=shard["out_rows"])
+
+    def _adopt(self, data: dict, shard: dict) -> None:
+        """Coordinator committed: reload the consensus-initialized
+        shard of the new world and keep training."""
+        self.world = int(data["world"])
+        self.out_rank = int(shard["out_rank"])
+        self.rows = int(shard["out_rows"])
+        self.rank_offset = int(shard["rank_offset"])
+        self.generation += 1
+        self.relaunches += 1
+        import flax.serialization
+
+        with open(self._ckpt_path(), "rb") as f:
+            raw = flax.serialization.msgpack_restore(f.read())
+        st = raw["state"]
+        self._state = {
+            "params": {"w": np.asarray(st["params"]["w"])},
+            "gossip": {
+                "ps_weight": np.asarray(st["gossip"]["ps_weight"]),
+                "phase": np.asarray(st["gossip"]["phase"])}}
+        self.step = int(raw["meta"].get("step", self.step))
+
+    def _rendezvous_wait(self, round_no: int) -> bool:
+        """Joined a barrier; block until go/excluded/terminal.  Returns
+        False when the host should exit."""
+        assign = shard = None
+        while not self.kill_event.is_set():
+            for ev in self.member.poll():
+                data = ev.get("data") or {}
+                phase = data.get("phase")
+                if ev.get("kind") == "rendezvous" and phase == "call":
+                    assign = shard = None
+                    self.member.join(data["round"])
+                elif ev.get("kind") == "fleet" and phase == "assign":
+                    mine = (data.get("shards") or {}).get(str(self.host))
+                    if mine is not None:
+                        assign, shard = data, mine
+                        self._reshard_and_ack(data, mine)
+                    elif self.host in (data.get("excluded") or []):
+                        self.exit_reason = "excluded"
+                        return False
+                elif (ev.get("kind") == "fleet" and phase == "go"
+                        and assign is not None
+                        and data.get("round") == assign.get("round")):
+                    self._adopt(assign, shard)
+                    return True
+                elif ev.get("kind") == "fleet" and phase in (
+                        "halt", "give-up", "complete"):
+                    self.exit_reason = f"coordinator {phase}"
+                    return False
+            time.sleep(self.poll_s)
+        return False
+
+    def run(self) -> None:  # pragma: no branch - thread entry
+        try:
+            self._run()
+        finally:
+            self._registry.close()
+
+    def _run(self) -> None:
+        if self.joiner:
+            # the broadcast tailer replays history; a joiner must only
+            # act on its own grow cycle
+            self.member.poll()
+            self.member.hello(world=self.world, generation=0,
+                              child_pid=os.getpid())
+            if not self._rendezvous_wait(0):
+                return
+        else:
+            self._state = self._init_state()
+            self.member.hello(world=self.world, generation=0,
+                              child_pid=os.getpid())
+            self._save()
+        while self.step < self.steps:
+            if self.kill_event.is_set():
+                return            # whole-slice SIGKILL: vanish silently
+            self._train_step()
+            if self.step % self.save_every == 0 \
+                    or self.step >= self.steps:
+                self._save()
+            self.member.maybe_alive(os.getpid())
+            for ev in self.member.poll():
+                data = ev.get("data") or {}
+                if ev.get("kind") == "rendezvous" \
+                        and data.get("phase") == "call":
+                    # drain barrier: the save IS the shard boundary
+                    self._save()
+                    self.member.join(data["round"])
+                    if not self._rendezvous_wait(data["round"]):
+                        return
+                elif ev.get("kind") == "fleet" \
+                        and data.get("phase") == "halt":
+                    self.exit_reason = "halt"
+                    return
+            time.sleep(self.step_s)
+        self._save()
+        self.member.done(0)
+        self.exit_reason = "complete"
+
+
+# -- scenario driver ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FleetReport:
+    """What one simulated-fleet scenario did, for assertions."""
+
+    rc: int
+    prev_world: int
+    world: int
+    cycles: int
+    calls: int
+    assigns: int
+    gos: int
+    excluded: list[int]
+    drift: float | None        # |consensus mean| change at the boundary
+    ps_weight_reset: bool | None
+    host_exit: dict[int, str | None]
+    host_relaunches: dict[int, int]
+
+    def summary(self) -> str:
+        return (f"world {self.prev_world} -> {self.world}, "
+                f"{self.cycles} cycle(s), {self.calls} call(s), "
+                f"{self.assigns} assign(s), {self.gos} go(s), "
+                f"excluded {self.excluded}, drift "
+                f"{'-' if self.drift is None else f'{self.drift:.2e}'}")
+
+
+def _coord_events(fleet_dir: str) -> list[dict]:
+    path = os.path.join(fleet_dir, COORDINATOR_EVENTS_FILE)
+    out = []
+    if os.path.isfile(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+def run_sim_fleet(fleet_dir: str, hosts: dict[int, int], *,
+                  steps: int = 20, save_every: int = 5,
+                  step_s: float = 0.005, seed: int = 0,
+                  campaign=None, join_rows: int | None = None,
+                  gossip: bool = False, gap_floor: float = 0.01,
+                  deadline_s: float = 2.0, host_timeout_s: float = 1.5,
+                  ack_timeout_s: float = 60.0, max_cycles: int = 2,
+                  timeout_s: float = 120.0) -> FleetReport:
+    """One fleet scenario end to end against the real coordinator.
+
+    ``hosts`` maps host id → rows; ``campaign`` (a
+    :class:`~.campaign.Campaign`) contributes ``kill_hosts`` (negative
+    ids index from the end) and ``coordinator_down_s``; ``join_rows``
+    adds one joiner host (id ``max+1``) once the initial fleet has
+    checkpointed, exercising the grow-the-world induction.
+    """
+    os.makedirs(fleet_dir, exist_ok=True)
+    world = sum(hosts.values())
+    offsets, off = {}, 0
+    for h in sorted(hosts):
+        offsets[h] = off
+        off += hosts[h]
+    sims = {h: SimHost(fleet_dir, h, hosts[h], offsets[h], world,
+                       steps=steps, save_every=save_every,
+                       step_s=step_s, seed=seed)
+            for h in sorted(hosts)}
+    for s in sims.values():
+        s.start()
+
+    def all_checkpointed() -> bool:
+        return all(os.path.isfile(s._ckpt_path()) for s in sims.values())
+
+    kill_hosts: list[int] = []
+    if campaign is not None:
+        order = sorted(hosts)
+        kill_hosts = [order[h] for h in campaign.kill_hosts]
+    joiner: SimHost | None = None
+
+    def chaos() -> None:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and not all_checkpointed():
+            time.sleep(0.05)
+        for h in kill_hosts:
+            sims[h].kill_event.set()
+        nonlocal joiner
+        if join_rows is not None:
+            jid = max(hosts) + 1
+            joiner = SimHost(fleet_dir, jid, join_rows, 0, world,
+                             steps=steps, save_every=save_every,
+                             step_s=step_s, seed=seed, join=True)
+            joiner.start()
+
+    chaos_thread = threading.Thread(target=chaos, daemon=True)
+    chaos_thread.start()
+
+    boundary: dict = {}
+
+    def on_cycle(assign: dict) -> None:
+        try:
+            old, _, _ = load_world_checkpoint(
+                fleet_dir, "", assign["prev_world"])
+            new, _, _ = load_world_checkpoint(
+                fleet_dir, "", assign["world"])
+            m_old, m_new = consensus_mean(old), consensus_mean(new)
+            boundary["drift"] = max(
+                float(np.abs(m_old[k] - m_new[k]).max()) for k in m_old)
+            boundary["ps_reset"] = bool(np.all(
+                np.asarray(new["gossip"]["ps_weight"]) == 1.0))
+        except Exception as e:  # sgplint: disable=SGPL007 (scenario report must survive any boundary-load failure and surface it as data)
+            boundary["error"] = repr(e)
+
+    if campaign is not None and campaign.coordinator_down_s:
+        # coordinator loss: it comes up late; the stream files queued
+        # everything and the tailers replay, so nothing is lost
+        time.sleep(campaign.coordinator_down_s)
+    coord = Coordinator(
+        fleet_dir, dict(hosts), checkpoint_dir=fleet_dir, tag="",
+        gossip=gossip, gap_floor=gap_floor,
+        deadline_s=deadline_s, host_timeout_s=host_timeout_s,
+        hello_grace_s=30.0, ack_timeout_s=ack_timeout_s,
+        poll_interval_s=0.05, max_cycles=max_cycles, min_hosts=1,
+        install_signal_handlers=False, on_cycle=on_cycle)
+    rc = coord.run()
+    chaos_thread.join(timeout=5)
+    for s in list(sims.values()) + ([joiner] if joiner else []):
+        if rc != 0:
+            s.kill_event.set()
+        s.join(timeout=30)
+
+    evs = _coord_events(fleet_dir)
+    calls = [e for e in evs if e.get("kind") == "rendezvous"
+             and e["data"].get("phase") == "call"]
+    assigns = [e for e in evs if e.get("kind") == "fleet"
+               and e["data"].get("phase") == "assign"]
+    gos = [e for e in evs if e.get("kind") == "fleet"
+           and e["data"].get("phase") == "go"]
+    everyone = dict(sims)
+    if joiner is not None:
+        everyone[joiner.host] = joiner
+    return FleetReport(
+        rc=rc, prev_world=world, world=coord.world, cycles=coord.cycle,
+        calls=len(calls), assigns=len(assigns), gos=len(gos),
+        excluded=sorted(coord.excluded),
+        drift=boundary.get("drift"),
+        ps_weight_reset=boundary.get("ps_reset"),
+        host_exit={h: s.exit_reason for h, s in everyone.items()},
+        host_relaunches={h: s.relaunches for h, s in everyone.items()})

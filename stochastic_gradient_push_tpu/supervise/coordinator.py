@@ -215,6 +215,11 @@ class Coordinator:
         self._round = 0       # monotone rendezvous round counter
         self.done: set[int] = set()
         self.excluded: list[int] = []
+        # grow-the-world: hosts that said hello but are not yet members.
+        # A hello from an unknown host id is a join request; it becomes
+        # a coordinated grow cycle (upward reshard n -> n') exactly like
+        # a fault becomes a shrink cycle.
+        self._joining: dict[int, int] = {}
         self.child_pids: dict[int, int] = {}
         self._last_seen: dict[int, float | None] = {
             h: None for h in self.live}
@@ -242,16 +247,33 @@ class Coordinator:
 
     # -- event intake ------------------------------------------------------
 
+    def _scan_new_hosts(self) -> None:
+        """Attach tailers for host directories that appeared after
+        startup — the transport half of grow-the-world.  A joining
+        host's supervisor creates ``host{h}/supervisor.jsonl`` before it
+        says hello; without this scan the hello would never be read."""
+        try:
+            names = os.listdir(self.fleet_dir)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("host") and name[4:].isdigit()):
+                continue
+            h = int(name[4:])
+            if h not in self._tailers:
+                self._tailers[h] = EventTailer(os.path.join(
+                    host_dir(self.fleet_dir, h), SUPERVISOR_EVENTS_FILE))
+
     def _poll_hosts(self) -> list[dict]:
         """Drain every host stream once: update liveness/fault/done
         bookkeeping, and return the raw ``rendezvous`` events so the
         phase loops (join/ack collection) can scan them too."""
+        self._scan_new_hosts()
         out: list[dict] = []
         now = time.time()
         for h, tailer in self._tailers.items():
             for ev in tailer.poll():
-                if h in self._last_seen:
-                    self._last_seen[h] = now
+                self._last_seen[h] = now
                 if ev.get("kind") != "rendezvous":
                     continue
                 data = ev.get("data") or {}
@@ -260,6 +282,14 @@ class Coordinator:
                     pid = data.get("child_pid")
                     if pid is not None:
                         self.child_pids[h] = int(pid)
+                    if (phase == "hello" and h not in self.live
+                            and h not in self.done):
+                        rows = int(data.get("rows") or 1)
+                        if self._joining.get(h) != rows:
+                            self._joining[h] = rows
+                            self.log.warning(
+                                "host %d asks to join with %d row(s)",
+                                h, rows)
                 elif phase == "fault" and h in self.live \
                         and h not in self._faulted:
                     self._faulted[h] = (f"host {h}: "
@@ -330,6 +360,10 @@ class Coordinator:
                 if silent is not None:
                     cause = (f"host-silence: host {silent[0]} quiet for "
                              f"{silent[1]:.0f}s")
+                elif self._joining:
+                    cause = "host-join: " + "; ".join(
+                        f"host {h} (+{r} rows)"
+                        for h, r in sorted(self._joining.items()))
             if cause is not None:
                 rc = self._cycle(cause)
                 if rc is not None:
@@ -353,7 +387,11 @@ class Coordinator:
                 f"{cause}, but the coordinated-cycle budget "
                 f"({self.max_cycles}) is spent")
         self.log.warning("fleet cycle %d: %s", self.cycle + 1, cause)
-        expected = {h for h in self.live if h not in self.done}
+        # joiners rendezvous alongside the incumbents: the barrier is
+        # how the whole fleet agrees on the grown world before any
+        # upward reshard happens
+        expected = ({h for h in self.live if h not in self.done}
+                    | set(self._joining))
         # every membership change re-runs the barrier; bound the total
         # rounds so a flapping fleet degrades to give-up, never a hang
         max_rounds = 2 * len(expected) + 2
@@ -375,6 +413,7 @@ class Coordinator:
                     self._round, missed, len(joined))
                 for h in missed:
                     self.live.pop(h, None)
+                    self._joining.pop(h, None)
                     self.excluded.append(h)
                 expected = set(joined)
                 if len(expected) < self.min_hosts:
@@ -395,6 +434,7 @@ class Coordinator:
                 "re-running the rendezvous", missed)
             for h in missed:
                 self.live.pop(h, None)
+                self._joining.pop(h, None)
                 self.excluded.append(h)
             expected = {h for h in expected if h not in missed}
             if len(expected) < self.min_hosts:
@@ -425,8 +465,12 @@ class Coordinator:
             "excluded %s", self.cycle, prev_world, self.world,
             len(joined), self.excluded)
         # fresh generation: clear fault flags and give every survivor a
-        # fresh liveness clock (its child recompiles from scratch)
+        # fresh liveness clock (its child recompiles from scratch).
+        # Joiners that made this generation are members now; one that
+        # hello'd mid-cycle stays queued and triggers the next cycle.
         self._faulted.clear()
+        self._joining = {h: r for h, r in self._joining.items()
+                         if h not in self.live}
         now = time.time()
         for h in self.live:
             self._last_seen[h] = now
@@ -477,8 +521,10 @@ class Coordinator:
                 if (msg.get("phase") == "join"
                         and msg.get("round") == self._round
                         and msg["host"] in expected):
-                    joined[msg["host"]] = int(
-                        msg.get("rows") or self.live[msg["host"]])
+                    h = msg["host"]
+                    joined[h] = int(
+                        msg.get("rows") or self.live.get(h)
+                        or self._joining.get(h, 1))
             time.sleep(self.poll_interval_s)
         return joined or None
 

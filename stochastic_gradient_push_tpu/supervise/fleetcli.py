@@ -310,6 +310,13 @@ def main(argv=None, child_env: dict | None = None) -> int:
                     help="run the pod coordinator")
     ap.add_argument("--host", type=int, default=None,
                     help="run host I's per-host supervisor (fleet mode)")
+    ap.add_argument("--join", action="store_true",
+                    help="host mode: this host is NOT in the "
+                         "coordinator's launch membership — say hello "
+                         "as a join request, wait for the coordinated "
+                         "grow cycle (upward reshard n -> n'), and "
+                         "launch the child only on the coordinator's "
+                         "go")
     ap.add_argument("--hosts", type=int, default=None,
                     help="coordinator: number of hosts (uniform slices)")
     ap.add_argument("--rows", type=int, default=None,
@@ -455,7 +462,7 @@ def main(argv=None, child_env: dict | None = None) -> int:
     sup = Supervisor(spec, policy, poll_interval_s=args.poll,
                      drain_timeout_s=args.drain_timeout,
                      fleet=member, fleet_timeout_s=args.fleet_timeout,
-                     child_env=child_env)
+                     fleet_join=args.join, child_env=child_env)
     rc = sup.run()
     if rc == REQUEUE_EXIT_CODE:
         print("fleet: host preempted after checkpoint; exiting "

@@ -212,6 +212,7 @@ class Supervisor:
                  install_signal_handlers: bool = True,
                  chaos_kill_after_checkpoint: bool = False,
                  fleet=None, fleet_timeout_s: float = 600.0,
+                 fleet_join: bool = False,
                  on_relaunch=None, log=None):
         self.spec = spec
         self.policy = policy or SupervisorPolicy(world=spec.world)
@@ -220,6 +221,14 @@ class Supervisor:
         # the coordinator's broadcast stream instead of being made here
         self.fleet = fleet
         self.fleet_timeout_s = fleet_timeout_s
+        # joiner mode: this host is NOT in the coordinator's launch
+        # membership — before launching any child it says hello (the
+        # join request), waits for the coordinated grow cycle, reshards
+        # its shard of the n -> n' upward reshard, and only launches on
+        # the coordinator's go
+        self.fleet_join = bool(fleet_join)
+        if self.fleet_join and fleet is None:
+            raise ValueError("fleet_join requires a FleetMember")
         self.poll_interval_s = poll_interval_s
         self.drain_timeout_s = drain_timeout_s
         # > 0: a live child with NO event traffic for this long counts as
@@ -317,6 +326,25 @@ class Supervisor:
         plan: dict | None = None
         extra: dict | None = None
         resume = False
+        if self.fleet_join:
+            # grow-the-world induction: no child exists yet — the hello
+            # below is the join request, and the first launch happens
+            # only on the coordinator's go, with the grown world's plan
+            # and this host's assigned shard already resharded
+            self.tailer.poll()
+            self.fleet.poll()   # the broadcast tailer replays from byte
+            # 0: drop history so we only act on our own grow cycle
+            self._emit("fleet-join", severity="warning",
+                       reason=f"joining the fleet with "
+                              f"{self.fleet.rows} row(s)")
+            self.fleet.hello(world=self.policy.world, generation=0,
+                             child_pid=None)
+            outcome = self._fleet_cycle(
+                Action("fleet-join", reason="joining the fleet"))
+            if isinstance(outcome, int):
+                return outcome
+            plan, extra = outcome
+            resume = True
         while True:
             argv = self.spec.build_argv(self.policy.world, plan, resume,
                                         extra=extra)
@@ -495,7 +523,7 @@ class Supervisor:
             self._drain_child()
         else:
             self._kill_child()
-        if action.kind != "fleet-rendezvous":
+        if action.kind not in ("fleet-rendezvous", "fleet-join"):
             self.fleet.fault(reason=action.reason, action=action.kind)
         # discard the dead generation's event tail (same discipline as
         # the single-host path: stale suggestions must not leak)

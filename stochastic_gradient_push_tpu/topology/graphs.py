@@ -63,8 +63,14 @@ class GraphTopology:
         self.world_size = int(world_size)
         self.peers_per_itr = int(peers_per_itr)
         self.phone_book: list[list[int]] = [[] for _ in range(self.world_size)]
+        # membership sets mirroring the phone book: dedup in O(1) so
+        # dense graphs (linear at pod-farm worlds: O(n) entries per
+        # rank) construct in O(n²) total instead of O(n³) list scans
+        self._book_sets: list[set[int]] = [set()
+                                           for _ in range(self.world_size)]
         if self.world_size > 1:
             self._make_graph()
+        del self._book_sets
         self._validate()
 
     # -- graph construction ------------------------------------------------
@@ -73,9 +79,11 @@ class GraphTopology:
         raise NotImplementedError
 
     def _add_peers(self, rank: int, peers) -> None:
+        book, seen = self.phone_book[rank], self._book_sets[rank]
         for peer in peers:
-            if peer != rank and peer not in self.phone_book[rank]:
-                self.phone_book[rank].append(int(peer))
+            if peer != rank and peer not in seen:
+                seen.add(peer)
+                book.append(int(peer))
 
     def _rotate_forward(self, r: int, p: int) -> int:
         return (r + p) % self.world_size

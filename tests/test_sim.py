@@ -1,0 +1,487 @@
+"""sim/: the priced-fabric fleet simulator.
+
+Pins the package's three contracts: (1) the mixing algebra is EXACT —
+the engine's fancy-index scatter is bit-identical to the dense
+permutation-matrix oracle, and faults compose through the resilience
+grammar's mass-conserving masks (column sums stay 1, the consensus
+target never moves); (2) time is *modeled with the planner's own cost
+vocabulary* — dropped edges ship nothing, DCN crossings carry the
+premium, fused intra phases price as grouped allreduces; (3) the fleet
+lane runs the REAL coordinator — a hello from a new host id produces
+exactly one coordinated n → n′ upward reshard (grow-the-world
+induction), here both in-process (simulated hosts) and, as a slow
+test, through ``scripts/fleet.py --join`` with real supervisor
+processes.  The sparse spectral-gap path and the cross-world grow
+reshard (256→320, 1024→1536) ride along — they are what make the
+simulator honest at world ≥ 1024.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import flax.serialization
+import numpy as np
+import pytest
+
+from stochastic_gradient_push_tpu.analysis import (
+    SPARSE_GAP_WORLD_MIN,
+    spectral_gap,
+)
+from stochastic_gradient_push_tpu.analysis.verifier import _sparse_gap
+from stochastic_gradient_push_tpu.planner.interconnect import (
+    InterconnectModel,
+)
+from stochastic_gradient_push_tpu.resilience import parse_fault_spec
+from stochastic_gradient_push_tpu.sim import (
+    FabricModel,
+    SimState,
+    cascading_slices_campaign,
+    consensus_curve,
+    coordinator_loss_campaign,
+    gossip_tick,
+    init_state,
+    kill_slice_campaign,
+    oracle_tick,
+    payload_bytes_for,
+    run_gossip,
+    run_sim_fleet,
+    sustained_churn_campaign,
+    sweep_curves,
+    time_to_error,
+)
+from stochastic_gradient_push_tpu.sim.fabric import (
+    PHASE_LATENCY_S,
+    SECONDS_PER_COST_BYTE,
+)
+from stochastic_gradient_push_tpu.supervise import (
+    Coordinator,
+    TornCheckpointError,
+    consensus_mean,
+    host_dir,
+    load_world_checkpoint,
+    reshard_checkpoints,
+)
+from stochastic_gradient_push_tpu.telemetry import (
+    COORDINATOR_EVENTS_FILE,
+    SUPERVISOR_EVENTS_FILE,
+)
+from stochastic_gradient_push_tpu.topology import TOPOLOGY_NAMES
+from stochastic_gradient_push_tpu.topology.schedule import build_schedule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _schedule(topology, world, ppi=1):
+    return build_schedule(TOPOLOGY_NAMES[topology](world,
+                                                   peers_per_itr=ppi))
+
+
+# -- engine: exactness + mass conservation -----------------------------------
+
+
+class TestEngine:
+    @pytest.mark.parametrize("topology,world,ppi", [
+        ("ring", 32, 1),
+        ("exponential", 32, 2),
+        ("linear", 16, 1),
+        ("bipartite-exponential", 16, 1),
+    ])
+    def test_bit_exact_vs_dense_oracle(self, topology, world, ppi):
+        # the core claim: the scatter engine IS the mixing matrix —
+        # same float ops in the same order, so array_equal, not allclose
+        sched = _schedule(topology, world, ppi)
+        st = init_state(world, seed=2)
+        oracle = SimState(params=st.params.copy(),
+                          ps_weight=st.ps_weight.copy())
+        for _ in range(2 * sched.num_phases + 1):
+            st = gossip_tick(st, sched)
+            oracle = oracle_tick(oracle, sched)
+        assert np.array_equal(st.params, oracle.params)
+        assert np.array_equal(st.ps_weight, oracle.ps_weight)
+
+    def test_consensus_contracts_toward_initial_mean(self):
+        sched = _schedule("exponential", 64)
+        _, errs = run_gossip(sched, 24, seed=4)
+        assert errs[-1] < errs[0] * 1e-3
+
+    def test_init_state_is_world_size_invariant(self):
+        # rank r's init never depends on the world, so a grown world's
+        # incumbents keep their values (the hostsim stream family)
+        big = init_state(6, seed=3)
+        tail = init_state(2, seed=3, rank_offset=4)
+        assert np.array_equal(big.params[4:], tail.params)
+
+    def test_mass_conserved_under_sustained_churn(self):
+        sched = _schedule("ring", 64)
+        camp = sustained_churn_campaign(prob=0.5, at=2, duration=40,
+                                        seed=9)
+        plan = parse_fault_spec(camp.fault_spec)
+        col0 = init_state(64, seed=6).params.sum(axis=0)
+        st, errs = run_gossip(sched, 48, seed=6, fault_plan=plan)
+        assert np.all(np.isfinite(st.params))
+        np.testing.assert_allclose(st.params.sum(axis=0), col0,
+                                   rtol=1e-11, atol=1e-11)
+        assert abs(st.ps_weight.sum() - 64.0) < 1e-9
+        assert errs[-1] < errs[0]
+
+    def test_nan_corruption_poisons_wire_not_weight_lane(self):
+        sched = _schedule("ring", 8)
+        plan = parse_fault_spec("nan:3@0:4")
+        st, _ = run_gossip(sched, 4, seed=1, fault_plan=plan)
+        # rank 3's outgoing payloads were NaN: its neighbors' params are
+        # poisoned, but the push-sum weight lane stays finite everywhere
+        assert np.any(np.isnan(st.params))
+        assert np.all(np.isfinite(st.ps_weight))
+
+
+# -- satellite: sparse spectral-gap path -------------------------------------
+
+
+class TestSparseSpectralGap:
+    @pytest.mark.parametrize("topology,world", [
+        ("ring", 16), ("ring", 64),
+        ("exponential", 16), ("exponential", 64),
+        ("linear", 32), ("bipartite-exponential", 32),
+    ])
+    def test_sparse_path_matches_dense_eig_below_threshold(
+            self, topology, world):
+        # below SPARSE_GAP_WORLD_MIN spectral_gap() takes the dense
+        # eigensolve; the subspace-iteration path must agree on the
+        # same schedules before we trust it alone at world >= 1024
+        assert world < SPARSE_GAP_WORLD_MIN
+        sched = _schedule(topology, world)
+        dense = spectral_gap(sched)
+        sparse = _sparse_gap(sched)
+        assert abs(dense - sparse) <= 1e-8
+
+    def test_sparse_path_is_the_dispatch_above_threshold(self):
+        # at world 256 spectral_gap() IS the sparse path — pin the
+        # dispatch and a planner-relevant ordering (exponential's
+        # log-diameter cycle out-mixes the ring's)
+        ring = spectral_gap(_schedule("ring", 256))
+        expo = spectral_gap(_schedule("exponential", 256))
+        assert ring == pytest.approx(_sparse_gap(_schedule("ring", 256)))
+        assert 0.0 < ring < expo <= 1.0 + 1e-12
+
+
+# -- fabric: modeled time in the planner's vocabulary ------------------------
+
+
+class TestFabric:
+    def test_payload_includes_push_sum_weight(self):
+        from stochastic_gradient_push_tpu.telemetry.comm import (
+            PS_WEIGHT_BYTES,
+            encoded_payload_bytes,
+        )
+
+        tree = {"w": np.zeros((1, 16), np.float32)}
+        assert payload_bytes_for(16) == (
+            encoded_payload_bytes(tree, world=1) + PS_WEIGHT_BYTES)
+
+    def test_dcn_crossings_carry_the_premium(self):
+        sched = _schedule("ring", 64)
+        pay = payload_bytes_for(16)
+        uniform = FabricModel(sched, None, pay)
+        sliced = FabricModel(
+            sched, InterconnectModel(slice_size=32, dcn_cost=16.0), pay)
+        assert sliced.cycle_time() > uniform.cycle_time()
+
+    def test_dropped_edges_ship_nothing(self):
+        # two slices: blacking one out removes EVERY cross-slice edge,
+        # so the slowest surviving rank pays only the ICI hop
+        sched = _schedule("ring", 64)
+        camp = kill_slice_campaign(64, 32, at=0, duration=8)
+        keep, _, _ = parse_fault_spec(camp.fault_spec).host_tables(sched)
+        fm = FabricModel(
+            sched, InterconnectModel(slice_size=32, dcn_cost=16.0),
+            payload_bytes_for(16))
+        assert fm.tick_time(0, keep_row=keep[0]) < fm.tick_time(0)
+
+    def test_fused_intra_phase_prices_as_grouped_allreduce(self):
+        sched = _schedule("hierarchical", 64)
+        g = sched.slice_size
+        fabric = InterconnectModel(slice_size=g, dcn_cost=16.0)
+        pay = payload_bytes_for(16)
+        fm = FabricModel(sched, fabric, pay)
+        p = list(sched.phase_kinds).index("intra")
+        want = PHASE_LATENCY_S + (pay * 2.0 * (g - 1) / g
+                                  * fabric.ici_cost
+                                  * SECONDS_PER_COST_BYTE)
+        assert fm.tick_time(p) == pytest.approx(want)
+
+
+# -- campaigns: the grammar they compile to ----------------------------------
+
+
+class TestCampaigns:
+    def test_kill_slice_compiles_and_validates(self):
+        camp = kill_slice_campaign(1024, 128)
+        assert camp.fault_spec.startswith("slice:896-1023@")
+        assert camp.kill_hosts == (7,)
+        parse_fault_spec(camp.fault_spec)   # grammar-valid
+        with pytest.raises(ValueError):
+            kill_slice_campaign(100, 32)    # not whole slices
+        with pytest.raises(ValueError):
+            kill_slice_campaign(64, 64)     # < 2 slices
+        with pytest.raises(ValueError):
+            kill_slice_campaign(64, 32, slice_idx=5)
+
+    def test_cascade_staggers_inside_recovery_shadow(self):
+        camp = cascading_slices_campaign(256, 32, count=3, at=100,
+                                         stagger=50, duration=150)
+        clauses = camp.fault_spec.split(";")
+        assert len(clauses) == 3 and len(camp.kill_hosts) == 3
+        starts = [int(c.split("@")[1].split(":")[0]) for c in clauses]
+        ends = [int(c.split("@")[1].split(":")[1]) for c in clauses]
+        # each loss lands while the previous one is still active
+        assert all(s2 < e1 for s2, e1 in zip(starts[1:], ends))
+        with pytest.raises(ValueError):
+            cascading_slices_campaign(128, 32, count=4)
+
+    def test_churn_and_coordinator_loss(self):
+        camp = sustained_churn_campaign(prob=0.5, at=50, duration=1000,
+                                        seed=3)
+        assert "drop_random:0.5@50:1050" in camp.fault_spec
+        assert "seed:3" in camp.fault_spec
+        with pytest.raises(ValueError):
+            sustained_churn_campaign(prob=1.5)
+        loss = coordinator_loss_campaign(down_s=2.5)
+        assert loss.fault_spec is None
+        assert loss.coordinator_down_s == 2.5
+        assert loss.kill_hosts == (-1,)     # fleet's last host
+        assert "coordinator dark 2.5s" in loss.describe()
+
+
+# -- curves: consensus against simulated wall-clock --------------------------
+
+
+class TestCurves:
+    def test_curve_shape_and_monotone_clock(self):
+        sched = _schedule("exponential", 32)
+        fabric = InterconnectModel(slice_size=16, dcn_cost=16.0)
+        curve = consensus_curve(sched, 20, interconnect=fabric, seed=1)
+        assert len(curve["time_s"]) == len(curve["error"]) == 20
+        assert np.all(np.diff(curve["time_s"]) > 0)
+        assert curve["cycle_time_s"] > 0
+        assert curve["payload_bytes"] == payload_bytes_for(16)
+        tte = time_to_error(curve, 1e-6)
+        assert tte is not None
+        first = int(np.argmax(np.asarray(curve["error"]) <= 1e-6))
+        assert tte == curve["time_s"][first]
+        assert time_to_error(curve, 0.0) is None
+
+    def test_sweep_covers_the_grid(self):
+        rows = sweep_curves(
+            {"ring": lambda w: _schedule("ring", w),
+             "exponential": lambda w: _schedule("exponential", w)},
+            worlds=(16, 32), steps=12, seed=2)
+        assert {(r["topology"], r["world"]) for r in rows} == {
+            ("ring", 16), ("ring", 32),
+            ("exponential", 16), ("exponential", 32)}
+        for r in rows:
+            assert r["final_error"] >= 0
+            assert r["cycle_time_s"] > 0
+
+
+# -- satellite: cross-world grow reshard -------------------------------------
+
+
+def _world_state(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {"w": rng.normal(size=(n, 8)).astype(np.float32)},
+        "gossip": {
+            # non-uniform push-sum weights: the consensus the reshard
+            # must preserve is Σ params / Σ ps_weight, not a plain mean
+            "ps_weight": rng.uniform(0.5, 1.5, n).astype(np.float32),
+            "phase": np.zeros(n, np.int32)},
+    }
+
+
+def _write_rank_file(directory, tag, rank, world, state, rows):
+    lo = rank * rows
+    sliced = {
+        "params": {"w": state["params"]["w"][lo:lo + rows]},
+        "gossip": {
+            "ps_weight": state["gossip"]["ps_weight"][lo:lo + rows],
+            "phase": state["gossip"]["phase"][lo:lo + rows]},
+    }
+    path = os.path.join(directory,
+                        f"{tag}checkpoint_r{rank}_n{world}.ckpt")
+    with open(path, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(
+            {"state": sliced, "meta": {"epoch": 1, "itr": 0,
+                                       "step": 7}}))
+    return path
+
+
+class TestReshardGrow:
+    @pytest.mark.parametrize("old,new,rows", [
+        (256, 320, 64),
+        (1024, 1536, 128),
+    ])
+    def test_upward_reshard_preserves_consensus(self, tmp_path, old,
+                                                new, rows):
+        d = str(tmp_path)
+        state = _world_state(old, seed=old)
+        for r in range(old // rows):
+            _write_rank_file(d, "", r, old, state, rows)
+        m_old = consensus_mean(state)
+        # every new-world host reshards its own disjoint shard
+        # concurrently — same call the supervisor's fleet cycle makes
+        for r in range(new // rows):
+            rep = reshard_checkpoints(d, "", old, new, out_rank=r,
+                                      out_rows=rows)
+            assert rep.mean_drift <= 1e-6
+        grown, meta, files = load_world_checkpoint(d, "", new)
+        assert len(files) == new // rows
+        m_new = consensus_mean(grown)
+        for k in m_old:
+            assert m_new[k].dtype == np.float64
+            assert float(np.abs(m_old[k] - m_new[k]).max()) <= 1e-6
+        ps = np.asarray(grown["gossip"]["ps_weight"])
+        assert ps.shape == (new,) and np.all(ps == 1.0)
+        assert np.all(np.asarray(grown["gossip"]["phase"]) == 0)
+
+    def test_torn_grow_set_is_rejected(self, tmp_path):
+        d = str(tmp_path)
+        state = _world_state(256, seed=1)
+        for r in range(4):
+            _write_rank_file(d, "", r, 256, state, 64)
+        # only 4 of the 5 world-320 shards land: rows don't cover the
+        # world, and the loader must refuse the torn set
+        for r in range(4):
+            reshard_checkpoints(d, "", 256, 320, out_rank=r,
+                                out_rows=64)
+        with pytest.raises(TornCheckpointError):
+            load_world_checkpoint(d, "", 320)
+
+
+# -- fleet lane: grow-the-world induction ------------------------------------
+
+
+class TestSimFleetGrow:
+    def test_join_hello_grows_world_4_to_6(self, tmp_path):
+        # 2-host world-4 fleet; a third simulated host says hello
+        # mid-run and the REAL coordinator runs one grow cycle to a
+        # 3-host world 6 (no replan: the assignment carries plan=None)
+        rep = run_sim_fleet(str(tmp_path), {0: 2, 1: 2}, steps=40,
+                            save_every=5, step_s=0.05, join_rows=2)
+        assert rep.rc == 0
+        assert rep.cycles == 1 and rep.gos == 1
+        assert rep.prev_world == 4 and rep.world == 6
+        assert rep.excluded == []
+        assert rep.drift is not None and rep.drift <= 1e-6
+        assert rep.ps_weight_reset is True
+        assert rep.host_exit.get(2) == "complete"
+        # exactly one coordinated cycle: nobody relaunched twice
+        assert all(n <= 1 for n in rep.host_relaunches.values())
+
+
+def _events(path):
+    import json
+
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.mark.slow
+def test_fleetcli_join_grows_world_4_to_6(tmp_path):
+    """The subprocess version of grow-the-world: two real
+    ``scripts/fleet.py`` host supervisors run hostsim children at
+    world 4; a third launches with ``--join`` and no child; the
+    in-process coordinator grows the fleet to world 6 in exactly one
+    coordinated cycle and everyone trains to completion."""
+    d = str(tmp_path)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    fleet_script = os.path.join(REPO, "scripts", "fleet.py")
+
+    def host_cmd(h, join=False):
+        sup = [sys.executable, fleet_script, "--host", str(h),
+               "--fleet_dir", d, "--poll", "0.1",
+               "--alive_interval", "0.5", "--drain_timeout", "30"]
+        if join:
+            sup.append("--join")
+        return sup + [
+            "--",
+            sys.executable, "-m",
+            "stochastic_gradient_push_tpu.supervise.hostsim",
+            "--checkpoint_dir", d, "--trace_dir", host_dir(d, h),
+            "--world_size", "4", "--num_processes", "2",
+            "--process_id", str(min(h, 1)), "--rows", "2",
+            "--rank_offset", str(h * 2), "--steps", "60",
+            "--save_every", "5", "--step_s", "0.1"]
+
+    sups = {h: subprocess.Popen(host_cmd(h), env=env) for h in (0, 1)}
+    boundary = {}
+
+    def on_cycle(assign):
+        old, _, _ = load_world_checkpoint(d, "", 4)
+        new, _, _ = load_world_checkpoint(d, "", 6)
+        m_old, m_new = consensus_mean(old), consensus_mean(new)
+        boundary["drift"] = max(
+            float(np.abs(m_old[k] - m_new[k]).max()) for k in m_old)
+        boundary["assign"] = assign
+        boundary["ps"] = np.asarray(
+            new["gossip"]["ps_weight"]).tolist()
+
+    def chaos_join():
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(os.path.isfile(os.path.join(
+                    d, f"checkpoint_r{h}_n4.ckpt")) for h in (0, 1)):
+                break
+            time.sleep(0.2)
+        sups[2] = subprocess.Popen(host_cmd(2, join=True), env=env)
+
+    import threading
+
+    joiner = threading.Thread(target=chaos_join, daemon=True)
+    joiner.start()
+    coord = Coordinator(
+        d, {0: 2, 1: 2}, checkpoint_dir=d, tag="", gossip=False,
+        deadline_s=5.0, host_timeout_s=10.0, hello_grace_s=60.0,
+        ack_timeout_s=60.0, poll_interval_s=0.1, max_cycles=2,
+        min_hosts=1, on_cycle=on_cycle)
+    rc = coord.run()
+    joiner.join(timeout=10)
+    for p in sups.values():
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            raise
+
+    assert rc == 0
+    assert 2 in sups, "the joiner never launched"
+    assert coord.world == 6 and coord.excluded == []
+    assert boundary.get("drift") is not None, "no grow cycle ran"
+    assert boundary["drift"] <= 1e-6
+    assert all(w == 1.0 for w in boundary["ps"])
+    assert boundary["assign"]["world"] == 6
+    assert boundary["assign"]["prev_world"] == 4
+    shards = boundary["assign"]["shards"]
+    assert sorted((s["out_rank"], s["out_rows"])
+                  for s in shards.values()) == [(0, 2), (1, 2), (2, 2)]
+
+    coord_evs = _events(os.path.join(d, COORDINATOR_EVENTS_FILE))
+    assigns = [e for e in coord_evs if e.get("kind") == "fleet"
+               and e["data"].get("phase") == "assign"]
+    gos = [e for e in coord_evs if e.get("kind") == "fleet"
+           and e["data"].get("phase") == "go"]
+    assert len(assigns) == 1 and len(gos) == 1
+    # the joiner reported fleet-join and relaunched into world 6
+    evs = _events(os.path.join(host_dir(d, 2), SUPERVISOR_EVENTS_FILE))
+    assert any(e["data"].get("action") == "fleet-join"
+               for e in evs if e.get("kind") == "supervisor")
+    rel = [e for e in evs if e.get("kind") == "relaunch"]
+    assert len(rel) == 1 and rel[0]["data"]["world"] == 6
+    # the grown world trained through to the end, un-torn
+    _, meta, files = load_world_checkpoint(d, "", 6)
+    assert meta.get("step") == 60 and len(files) == 3
