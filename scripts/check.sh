@@ -63,6 +63,10 @@ echo "== serve self-check (train -> consensus ingest -> paged-attention serving)
 python scripts/serve.py --selftest
 
 echo
+echo "== fleetmon self-check (replayed kill-slice campaign -> merge -> metrics -> SLO alerts -> merged trace) =="
+python scripts/fleetmon.py --selftest
+
+echo
 echo "== sim self-check (exact engine vs oracle, priced fabric, fleet at world 1024, grow 4->6) =="
 python scripts/sim.py --selftest
 

@@ -38,9 +38,8 @@ from stochastic_gradient_push_tpu.telemetry import (  # noqa: E402
     SCHEMA_VERSION,
     SUPERVISOR_EVENTS_FILE,
     TRACE_FILE,
-)
-from stochastic_gradient_push_tpu.utils.meter import (  # noqa: E402
-    PercentileMeter,
+    request_latency_meter,
+    step_time_meter,
 )
 
 # -- loading ---------------------------------------------------------------
@@ -187,8 +186,10 @@ def build_report(run_dir: str) -> dict:
         by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
 
     # step-time percentiles from timed train_step spans (warmup/compile
-    # spans carry timed=False and are excluded)
-    meter = PercentileMeter(maxlen=65536, ptag="step")
+    # spans carry timed=False and are excluded) — via the SHARED helper
+    # (telemetry.metrics), so this report and fleetmon's live summary
+    # compute the same p50/p99 by construction (pinned in selftest)
+    meter = step_time_meter(trace)
     gossip_durs, plain_durs = [], []
     phase_totals: dict[str, float] = {}
     for ev in trace:
@@ -201,11 +202,9 @@ def build_report(run_dir: str) -> dict:
             args = ev.get("args", {})
             steps = max(1, int(args.get("steps", 1)))
             per_step = dur_s / steps
-            if args.get("timed", True):
-                meter.update(per_step)
-                if "gossip" in args:
-                    (gossip_durs if args["gossip"] else
-                     plain_durs).append(per_step)
+            if args.get("timed", True) and "gossip" in args:
+                (gossip_durs if args["gossip"] else
+                 plain_durs).append(per_step)
 
     # measured gossip overhead: only measurable when the run thinned
     # communication (gossip_every > 1) so both step classes exist
@@ -301,11 +300,10 @@ def build_report(run_dir: str) -> dict:
                         if ev["data"].get("phase") == "summary"), None)
         rejects = sum(1 for ev in serve_evs
                       if ev["data"].get("phase") == "reject")
-        lat = PercentileMeter(maxlen=65536, ptag="request_latency_s")
-        req_tokens = 0
-        for ev in request_evs:
-            lat.update(float(ev["data"].get("latency_s", 0.0)))
-            req_tokens += int(ev["data"].get("new_tokens", 0))
+        # serve latency through the same shared helper fleetmon uses
+        lat = request_latency_meter(request_evs)
+        req_tokens = sum(int(ev["data"].get("new_tokens", 0))
+                        for ev in request_evs)
         serving = {
             "summary": ({k: v for k, v in summary.items()
                          if k != "phase"} if summary else None),
@@ -711,6 +709,29 @@ def selftest() -> int:
                    == art["admission_rejections"] == 1,
                    f"rejection rows: {sv['rejections_observed']} vs "
                    f"{art['admission_rejections']}")
+        # the shared-helper pin: fleetmon's live summary of the SAME
+        # run dir must agree with this report EXACTLY on step-time and
+        # serve-latency percentiles (both go through
+        # telemetry.metrics.step_time_meter / request_latency_meter)
+        # and on the comm snapshot — the two consumers can never
+        # disagree on what p50/p99 mean
+        from stochastic_gradient_push_tpu.telemetry.aggregate import (
+            FleetAggregator)
+        agg = FleetAggregator(d, write_alerts=False)
+        agg.drain()
+        fm = agg.summary()
+        agg.close()
+        expect(fm["step_time"] == report["step_time"],
+               f"fleetmon step_time {fm['step_time']} != obsreport "
+               f"{report['step_time']}")
+        expect(fm["serving"]["p50_latency_s"] == sv["p50_latency_s"]
+               and fm["serving"]["p99_latency_s"]
+               == sv["p99_latency_s"],
+               f"fleetmon serve latency {fm['serving']} != obsreport "
+               f"{sv}")
+        expect(fm["comm"] == report["comm"],
+               "fleetmon comm snapshot != obsreport comm snapshot")
+
         # the analytic gate: reported bytes equal the model's expectation
         want = model.totals(num_steps)
         want["recovery"] = allreduce_bytes(payload, 8)

@@ -38,7 +38,7 @@ import re
 from .findings import Finding
 
 __all__ = ["lint_file", "lint_paths", "collect_axis_vocabulary",
-           "COLLECTIVE_FNS", "iter_py_files"]
+           "collect_metric_vocabulary", "COLLECTIVE_FNS", "iter_py_files"]
 
 
 # canonical dotted names of named-axis collectives whose axis argument the
@@ -66,6 +66,15 @@ _HOST_EFFECTS = {
 
 # jax.random callables that *refresh* rather than consume a key
 _KEY_REFRESHERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data"}
+
+# metrics-registry construction surface (telemetry/metrics.py): the
+# attribute calls whose first (name) argument SGPL014 checks against the
+# registered metric-name vocabulary.  Attribute-name matching keeps the
+# rule alias-proof; precision-first — a name argument that doesn't
+# resolve to a string through the module's own constants stays silent
+# (imported constants are by construction registered where they're
+# defined)
+_METRIC_ATTRS = {"counter", "gauge", "histogram"}
 
 # telemetry emission surface (telemetry/ tracer + registry): attribute
 # calls banned in traced code (SGPL009) — a span or event emitted inside
@@ -309,6 +318,48 @@ def _module_axes(mod: _Module) -> set[str]:
     return axes
 
 
+def _module_metrics(mod: _Module) -> set[str]:
+    """One module's metric-name declarations (the per-file contribution
+    to the SGPL014 vocabulary): a module-level ``*METRIC_NAMES``
+    assignment to a ``frozenset({...})`` / ``set`` / literal set, string
+    elements taken directly and Name elements resolved through the
+    module's own string constants."""
+    names: set[str] = set()
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("METRIC_NAMES")):
+            continue
+        val = node.value
+        if isinstance(val, ast.Call) \
+                and _dotted(val.func) in ("frozenset", "set") and val.args:
+            val = val.args[0]
+        if isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+            for el in val.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, str):
+                    names.add(el.value)
+                elif isinstance(el, ast.Name) \
+                        and el.id in mod.constants:
+                    names.add(mod.constants[el.id])
+    return names
+
+
+def collect_metric_vocabulary(paths) -> set[str]:
+    """Metric names registered anywhere under ``paths``: every
+    module-level ``*METRIC_NAMES = frozenset({...})`` declaration
+    (telemetry/metrics.py owns the canonical one)."""
+    metrics: set[str] = set()
+    for path in iter_py_files(paths):
+        try:
+            source = open(path).read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        metrics |= _module_metrics(_Module(path, source, tree))
+    return metrics
+
+
 def collect_axis_vocabulary(paths) -> set[str]:
     """Mesh axis names declared anywhere under ``paths``.
 
@@ -330,9 +381,11 @@ def collect_axis_vocabulary(paths) -> set[str]:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, mod: _Module, axes: set[str], relpath: str,
-                 extra_traced: frozenset = frozenset()):
+                 extra_traced: frozenset = frozenset(),
+                 metrics: set[str] | frozenset = frozenset()):
         self.mod = mod
         self.axes = axes
+        self.metrics = metrics
         self.relpath = relpath
         self.traced = _collect_traced(mod, extra_traced)
         self.findings: list[Finding] = []
@@ -404,6 +457,7 @@ class _Linter(ast.NodeVisitor):
         name = self.mod.canonical(node.func)
         if name in COLLECTIVE_FNS:
             self._check_axis_arg(node, name)
+        self._check_metric_name(node)
         if self.in_traced():
             self._check_host_effect(node, name)
             self._check_telemetry_emission(node)
@@ -442,6 +496,32 @@ class _Linter(ast.NodeVisitor):
                              "parallel/wire.py WireCodec "
                              "(single-encode-path invariant)")
                     return
+
+    # -- SGPL014: closed metric-name vocabulary ----------------------------
+
+    def _check_metric_name(self, node: ast.Call) -> None:
+        """A ``.counter(name)`` / ``.gauge(name)`` / ``.histogram(name)``
+        whose name resolves to a string not registered in any
+        ``*METRIC_NAMES`` declaration forks the exposition namespace.
+        An empty vocabulary disables the rule (nothing to check
+        against); an unresolvable argument stays silent — an imported
+        constant Name is registered where it is defined."""
+        if not self.metrics:
+            return
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_ATTRS and node.args):
+            return
+        a = node.args[0]
+        val = None
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            val = a.value
+        elif isinstance(a, ast.Name) and a.id in self.mod.constants:
+            val = self.mod.constants[a.id]
+        if val is not None and val not in self.metrics:
+            self.add(node, "SGPL014",
+                     f".{node.func.attr}('{val}') uses a metric name no "
+                     "*METRIC_NAMES declaration registers — register it "
+                     "in telemetry/metrics.py (closed vocabulary)")
 
     # -- SGPL009: telemetry emission in traced code ------------------------
 
@@ -684,8 +764,10 @@ def _resolve_import(entry_path: str, level: int, module: str,
 
 
 def _lint_mod(mod: _Module, axes: set[str], relpath: str,
-              extra_traced: frozenset = frozenset()) -> list[Finding]:
-    linter = _Linter(mod, axes, relpath, extra_traced)
+              extra_traced: frozenset = frozenset(),
+              metrics: set[str] | frozenset = frozenset()
+              ) -> list[Finding]:
+    linter = _Linter(mod, axes, relpath, extra_traced, metrics)
     linter.visit(mod.tree)
     return sorted(linter.findings)
 
@@ -731,7 +813,8 @@ def build_program(paths, cache=None):
 
 
 def lint_program(paths, axes: set[str] | None = None,
-                 relto: str | None = None, cache=None):
+                 relto: str | None = None, cache=None,
+                 metrics: set[str] | None = None):
     """Whole-program lint: Engine 1 per module under the **full
     transitive fixpoint** traced closure, plus Engine 3's
     interprocedural SPMD-hazard rules over the call graph.
@@ -748,6 +831,11 @@ def lint_program(paths, axes: set[str] | None = None,
         axes = set()
         for iface in graph.interfaces.values():
             axes.update(iface.axes)
+    if metrics is None:
+        # like axes: the linted file set declares its own vocabulary
+        metrics = set()
+        for iface in graph.interfaces.values():
+            metrics.update(getattr(iface, "metrics", ()))
     findings: list[Finding] = []
     for apath in graph.interfaces:
         mod, sha = sources[apath]
@@ -755,14 +843,14 @@ def lint_program(paths, axes: set[str] | None = None,
         seeds = graph.traced_seeds(apath)
         cached = None
         if cache is not None and sha is not None:
-            env = env_sha(seeds, axes, rel)
+            env = env_sha(seeds, axes, rel, metrics)
             cached = cache.get_findings(apath, sha, env)
         if cached is None:
             if mod is None:  # interface was cached but findings were not
                 source = open(apath).read()
                 mod = _Module(apath, source,
                               ast.parse(source, filename=apath))
-            cached = _lint_mod(mod, axes, rel, seeds)
+            cached = _lint_mod(mod, axes, rel, seeds, metrics)
             if cache is not None and sha is not None:
                 cache.put_findings(apath, sha, env, cached)
         findings.extend(cached)
@@ -772,11 +860,13 @@ def lint_program(paths, axes: set[str] | None = None,
     return sorted(findings), graph
 
 
-def lint_file(path: str, axes: set[str], relto: str | None = None
-              ) -> list[Finding]:
+def lint_file(path: str, axes: set[str], relto: str | None = None,
+              metrics: set[str] | None = None) -> list[Finding]:
     """Lint one file in isolation: Engine 1 plus Engine 3 over the
     singleton call graph (no cross-module closure — use
-    :func:`lint_paths` for that)."""
+    :func:`lint_paths` for that).  ``metrics`` None = the file's own
+    ``*METRIC_NAMES`` declarations (so a fixture carrying its own
+    vocabulary lints self-contained)."""
     from .callgraph import build_graph, extract_interface
     from .spmd import analyze_program
 
@@ -788,16 +878,22 @@ def lint_file(path: str, axes: set[str], relto: str | None = None
     iface = extract_interface(mod)
     iface.path = apath
     graph = build_graph({apath: iface})
-    findings = _lint_mod(mod, axes, rel, graph.traced_seeds(apath))
+    if metrics is None:
+        metrics = _module_metrics(mod)
+    findings = _lint_mod(mod, axes, rel, graph.traced_seeds(apath),
+                         metrics)
     findings.extend(analyze_program(graph, relto=relto))
     return sorted(findings)
 
 
 def lint_paths(paths, axes: set[str] | None = None,
-               relto: str | None = None, cache=None) -> list[Finding]:
-    """Lint every ``.py`` under ``paths``; axis vocabulary defaults to
-    what the same paths declare.  Linting a file *set* enables the
-    whole-program call-graph closure: tracedness propagates along call
-    edges across any number of import hops (full transitive fixpoint),
-    and Engine 3's interprocedural rules run over the resulting graph."""
-    return lint_program(paths, axes=axes, relto=relto, cache=cache)[0]
+               relto: str | None = None, cache=None,
+               metrics: set[str] | None = None) -> list[Finding]:
+    """Lint every ``.py`` under ``paths``; axis and metric vocabularies
+    default to what the same paths declare.  Linting a file *set*
+    enables the whole-program call-graph closure: tracedness propagates
+    along call edges across any number of import hops (full transitive
+    fixpoint), and Engine 3's interprocedural rules run over the
+    resulting graph."""
+    return lint_program(paths, axes=axes, relto=relto, cache=cache,
+                        metrics=metrics)[0]

@@ -30,7 +30,7 @@ from .findings import Finding
 __all__ = ["LintCache", "content_sha", "CACHE_SCHEMA"]
 
 # bump whenever interface extraction or any engine's rules change shape
-CACHE_SCHEMA = 1
+CACHE_SCHEMA = 2  # 2: ModuleInterface.metrics + SGPL014 env keying
 
 DEFAULT_CACHE_PATH = os.path.join("artifacts", "sgplint_cache.json")
 
@@ -39,8 +39,9 @@ def content_sha(source: bytes) -> str:
     return hashlib.sha256(source).hexdigest()[:24]
 
 
-def env_sha(seeds, axes, relpath: str) -> str:
-    blob = json.dumps([sorted(seeds), sorted(axes), relpath])
+def env_sha(seeds, axes, relpath: str, metrics=()) -> str:
+    blob = json.dumps([sorted(seeds), sorted(axes), relpath,
+                       sorted(metrics)])
     return hashlib.sha256(blob.encode()).hexdigest()[:24]
 
 

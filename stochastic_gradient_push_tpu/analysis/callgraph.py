@@ -33,6 +33,7 @@ from .astlint import (
     _Module,
     _func_name_args,
     _module_axes,
+    _module_metrics,
     _resolve_import,
     _TRACING_WRAPPERS,
 )
@@ -143,6 +144,10 @@ class ModuleInterface:
     kernel_findings: list = dataclasses.field(default_factory=list)
     # mesh axis names this file declares (vocabulary contribution)
     axes: list = dataclasses.field(default_factory=list)
+    # metric names this file registers via *METRIC_NAMES (the SGPL014
+    # vocabulary contribution; telemetry/metrics.py owns the canonical
+    # declaration)
+    metrics: list = dataclasses.field(default_factory=list)
 
     def to_dict(self):
         d = dataclasses.asdict(self)
@@ -239,6 +244,7 @@ class _Extractor:
         self.iface = ModuleInterface(path=mod.path)
         self.iface.from_imports = [tuple(t) for t in mod.from_imports]
         self.iface.axes = sorted(_module_axes(mod))
+        self.iface.metrics = sorted(_module_metrics(mod))
         self._synth_n = 0
 
     def run(self) -> ModuleInterface:

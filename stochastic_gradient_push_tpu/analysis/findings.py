@@ -22,10 +22,11 @@ class Rule(NamedTuple):
     severity: str = "error"   # "error" gates CI; "warning" is advisory
 
 
-# rule id -> (summary, fix hint, severity).  L-rules 001-010 come from
-# the per-module AST engine, 011-013 from the whole-program SPMD-hazard
-# engine, V-rules from the semantic schedule verifier.  The catalog is
-# the single source of truth: docs/sgplint_rules.md is generated from it
+# rule id -> (summary, fix hint, severity).  L-rules 001-010 and 014
+# come from the per-module AST engine, 011-013 from the whole-program
+# SPMD-hazard engine, V-rules from the semantic schedule verifier.  The
+# catalog is the single source of truth: docs/sgplint_rules.md is
+# generated from it
 # (`--rules-md`), and tests assert every rule here has a firing fixture.
 RULES: dict[str, Rule] = {
     "SGPL001": Rule(
@@ -102,6 +103,17 @@ RULES: dict[str, Rule] = {
         "barrier waits to the number of signals, and derive "
         "collective_id from the COLLECTIVE_ID_SLOTS pool "
         "(ops/gossip_kernel.py is the reference shape)"),
+    "SGPL014": Rule(
+        "metric name is not in the registered vocabulary: a "
+        ".counter()/.gauge()/.histogram() call whose name string is not "
+        "declared in any module-level *METRIC_NAMES frozenset — ad-hoc "
+        "names fork the exposition namespace (dashboards and SLO rules "
+        "key on exact metric names, so a typo silently records to a "
+        "parallel series nobody watches)",
+        "register the name as a constant in telemetry/metrics.py (and "
+        "add it to METRIC_NAMES) instead of inlining a string literal; "
+        "the registry raises on unregistered names at runtime, this "
+        "rule catches the fork before it runs"),
     "SGPV101": Rule(
         "gossip phase sub-round is not a permutation (ppermute would drop "
         "or duplicate messages)",
@@ -223,9 +235,9 @@ def render_rules_markdown() -> str:
         "regenerate with `python scripts/sgplint.py --rules-md "
         "docs/sgplint_rules.md`.",
         "",
-        "Engines: **SGPL001–010** per-module AST lint, **SGPL011–013** "
-        "whole-program SPMD-hazard analysis over the call-graph closure, "
-        "**SGPV1xx** semantic schedule verifier.",
+        "Engines: **SGPL001–010, 014** per-module AST lint, "
+        "**SGPL011–013** whole-program SPMD-hazard analysis over the "
+        "call-graph closure, **SGPV1xx** semantic schedule verifier.",
         "",
         "Waiver syntax: `# sgplint: disable=<RULE>[,<RULE>...] (<why>)` "
         "on the offending line or the line above; `disable=all` silences "

@@ -25,11 +25,13 @@ import time
 
 from ..topology import GRAPH_TOPOLOGIES, TOPOLOGY_NAMES
 from .gossip_sgd import (add_fleet_flags, add_kernel_flag,
-                         add_staleness_flag, add_synth_flags,
-                         add_wire_flags, reject_push_sum_wire_knobs,
+                         add_profile_flags, add_staleness_flag,
+                         add_synth_flags, add_wire_flags,
+                         reject_push_sum_wire_knobs,
                          resolve_fleet_flags, resolve_kernel_flag,
-                         resolve_staleness_flag, resolve_wire_flags,
-                         synth_plan_config, wire_plan_config)
+                         resolve_profile_flags, resolve_staleness_flag,
+                         resolve_wire_flags, synth_plan_config,
+                         wire_plan_config)
 
 __all__ = ["main", "build_parser"]
 
@@ -201,13 +203,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "rows ride the CSV print cadence")
     p.add_argument("--val_batches", default=8, type=int,
                    help="validation batches per evaluation")
-    p.add_argument("--profile_dir", default=None, type=str,
-                   help="capture a jax.profiler trace of steps 2..4 into "
-                        "this directory (TensorBoard format).  Over "
-                        "tunneled backends the profiler RPC hangs; the "
-                        "run then continues untraced with a warning and "
-                        "the supported attribution is the fwd/fwdbwd "
-                        "probes (docs/MFU_ANALYSIS.md)")
+    add_profile_flags(p)
     p.add_argument("--trace_dir", default=None, type=str,
                    help="run telemetry directory (telemetry/): "
                         "trace.json host spans + events.jsonl typed "
@@ -358,6 +354,7 @@ def main(argv=None):
         raise SystemExit("--metrics_every needs --trace_dir (telemetry "
                          "events have nowhere to go without it)")
     resolve_fleet_flags(args)
+    resolve_profile_flags(args)
     if args.health_every < 0:
         raise SystemExit("--health_every must be >= 0")
     if args.health_every:
@@ -735,6 +732,13 @@ def main(argv=None):
             "num_steps": args.num_steps,
             "comm_model": (rt.comm.model.to_dict()
                            if rt.comm is not None else None)}
+        if args.profile_dir:
+            # where the XPlane dump lands + the captured step window,
+            # discoverable from the run directory (obsreport/fleetmon)
+            run_meta["profile_dir"] = args.profile_dir
+            run_meta["profile_window"] = [
+                args.profile_start_step,
+                args.profile_start_step + args.profile_steps]
         if args.fleet:
             run_meta["fleet"] = True
             run_meta["host_id"] = (args.host_id
@@ -1040,7 +1044,14 @@ def main(argv=None):
 
     last_val = None
     last_stats_emit = start_step
-    prof_started = prof_stopped = False
+    # step-indexed jax.profiler capture (shared with the image harness;
+    # utils/profiling.py tunnel caveat: a hung profiler RPC abandons the
+    # window and the run continues untraced)
+    from ..utils.profiling import ProfileWindow
+
+    pw = ProfileWindow(args.profile_dir,
+                       start_step=args.profile_start_step,
+                       num_steps=args.profile_steps)
     try:
         while steps_done < args.num_steps:
             for tokens, targets in lm_batches(corpus, dp * ep, sp,
@@ -1049,6 +1060,8 @@ def main(argv=None):
                 if skip_batches:
                     skip_batches -= 1
                     continue
+                if pw.enabled:
+                    pw.maybe_start(steps_done + 1)
                 state, metrics = train_fn(state, globalize(shape_batch(tokens)),
                                           globalize(shape_batch(targets)))
                 if serialize:
@@ -1058,24 +1071,11 @@ def main(argv=None):
                     # step tick is 0-based (matches the algorithm's phase
                     # counter); host integer math, dispatch stays async
                     rt.comm.on_step(steps_done - 1)
-                if args.profile_dir and not prof_stopped:
-                    # bounded trace window: steps 2-4 (step 1 pays the
-                    # compile).  Guarded: over a tunneled backend the
-                    # profiler RPC hangs, so a timed-out start/stop degrades
-                    # to probe-only attribution instead of stalling the run
-                    # (utils/profiling.py tunnel caveat)
-                    from ..utils.profiling import (start_trace_guarded,
-                                                   stop_trace_guarded)
-
-                    if not prof_started and steps_done == start_step + 1:
-                        if start_trace_guarded(args.profile_dir):
-                            prof_started = True
-                        else:
-                            prof_stopped = True  # don't retry a hung profiler
-                    elif prof_started and steps_done >= start_step + 4:
-                        jax.block_until_ready(state)
-                        stop_trace_guarded()
-                        prof_stopped = True
+                if pw.active:
+                    # the capture must cover the dispatched step even when
+                    # the loop itself runs unserialized
+                    jax.block_until_ready(state)
+                    pw.maybe_stop(steps_done)
                 if steps_done % args.print_freq == 0                     or steps_done >= args.num_steps:
                     guard = (watchdog.step()
                              if watchdog is not None and prints_done >= 1
@@ -1196,11 +1196,10 @@ def main(argv=None):
         if use_orbax:
             ckpt.wait()  # async saves must land before exit
             ckpt.close()
-        if prof_started and not prof_stopped:
-            from ..utils.profiling import stop_trace_guarded
-
-            stop_trace_guarded()
     finally:
+        # a run that ended inside the capture window still dumps what it
+        # got (close() is a no-op when no capture is active)
+        pw.close()
         # trace.json + the final comm snapshot must survive a
         # crashed or interrupted run (same contract as the
         # Trainer's fit() finally); finish() is idempotent

@@ -22,7 +22,6 @@ Run (virtual 8-device CPU mesh):
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import os
 import sys
 
@@ -196,6 +195,46 @@ def resolve_fleet_flags(args) -> bool:
                          "supervisor tails the typed event stream)")
     args.fleet = fleet
     return fleet
+
+
+def add_profile_flags(p: argparse.ArgumentParser) -> None:
+    """Device-profiling flags, shared by both run CLIs: a step-indexed
+    ``jax.profiler`` capture window inside the REAL run
+    (utils/profiling.ProfileWindow — one shot, tunnel-guarded)."""
+    p.add_argument("--profile_dir", default=None, type=str,
+                   help="capture a jax.profiler device trace of global "
+                        "steps [--profile_start_step, +--profile_steps) "
+                        "into this directory (TensorBoard XPlane "
+                        "format); the dump path is stamped into "
+                        "run_meta.  On tunneled backends a hung "
+                        "profiler RPC abandons the window and the run "
+                        "continues untraced (utils/profiling.py)")
+    p.add_argument("--profile_start_step", default=None, type=int,
+                   help="first global step of the capture window "
+                        "(default 2: past the compile and the "
+                        "donation-driven second compile)")
+    p.add_argument("--profile_steps", default=None, type=int,
+                   help="steps captured in the window (default 3; a "
+                        "bounded window — a full-run device trace is "
+                        "unloadable for real jobs)")
+
+
+def resolve_profile_flags(args) -> None:
+    """Validate and default the profiling flags in place (shared by
+    both CLIs): window knobs without a destination are a mistake."""
+    knobs_set = (args.profile_start_step is not None
+                 or args.profile_steps is not None)
+    if knobs_set and not args.profile_dir:
+        raise SystemExit("--profile_start_step/--profile_steps shape "
+                         "the capture window; they need --profile_dir")
+    if args.profile_start_step is None:
+        args.profile_start_step = 2
+    if args.profile_steps is None:
+        args.profile_steps = 3
+    if args.profile_start_step < 0:
+        raise SystemExit("--profile_start_step must be >= 0")
+    if args.profile_steps < 1:
+        raise SystemExit("--profile_steps must be >= 1")
 
 
 def add_staleness_flag(p: argparse.ArgumentParser) -> None:
@@ -418,10 +457,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan_steps", default=1, type=int,
                    help="fuse this many iterations into one compiled "
                         "program (dispatch amortization on TPU)")
-    p.add_argument("--profile_dir", default=None, type=str,
-                   help="capture a jax.profiler device trace into this "
-                        "directory (TensorBoard format); bounded by "
-                        "--profile_epochs")
     p.add_argument("--per_rank_csv", default="False", type=str,
                    help="emit one CSV per gossip rank (reference parity) "
                         "instead of a single rank-averaged file")
@@ -442,9 +477,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_backend", default="msgpack",
                    choices=["msgpack", "orbax"],
                    help="checkpoint serialization backend")
-    p.add_argument("--profile_epochs", default=1, type=int,
-                   help="trace only the first N epochs of the run "
-                        "(a full-run trace is unloadable for real jobs)")
     p.add_argument("--trace_dir", default=None, type=str,
                    help="run telemetry directory (telemetry/): writes "
                         "trace.json (Chrome-trace host spans: data "
@@ -458,6 +490,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit a step_stats + comm telemetry event "
                         "every k steps (0 = only the final comm "
                         "snapshot); requires --trace_dir")
+    add_profile_flags(p)
     add_fleet_flags(p)
     return p
 
@@ -523,6 +556,7 @@ def parse_config(argv=None):
         raise SystemExit("--metrics_every needs --trace_dir (telemetry "
                          "events have nowhere to go without it)")
     resolve_fleet_flags(args)
+    resolve_profile_flags(args)
     # a forced name overrides the integer registry; 'auto' is resolved in
     # main() once the world size is known (planner.resolve_topology)
     graph_class = GRAPH_TOPOLOGIES[args.graph_type]
@@ -579,6 +613,9 @@ def parse_config(argv=None):
         residual_floor=args.residual_floor,
         trace_dir=args.trace_dir,
         metrics_every=args.metrics_every,
+        profile_dir=args.profile_dir,
+        profile_start_step=args.profile_start_step,
+        profile_steps=args.profile_steps,
         fleet=bool(args.fleet),
         host_id=args.host_id,
     )
@@ -793,21 +830,6 @@ def main(argv=None, config_transform=None, extra_args=None):
                           channels),
                       cluster_manager=cluster, telemetry=telemetry)
     state = trainer.init_state()
-    if args.profile_dir:
-        # profile a bounded window: a separate short fit() under the trace,
-        # then continue the real run untraced.  trace_dir=None: the
-        # profile trainer must not race the real run's telemetry files
-        from ..utils import trace
-
-        profile_cfg = dataclasses.replace(
-            cfg, num_epochs=min(args.profile_epochs, cfg.num_epochs),
-            train_fast=True, resume=False, trace_dir=None)
-        profile_trainer = Trainer(
-            profile_cfg, model, mesh,
-            sample_input_shape=(cfg.batch_size, args.image_size,
-                                args.image_size, channels))
-        with trace(args.profile_dir):
-            state, _ = profile_trainer.fit(state, loader, sampler, None)
     state, result = trainer.fit(state, loader, sampler, val_loader)
     if hasattr(ckpt, "wait"):
         ckpt.wait()  # async backends: land in-flight saves before exit

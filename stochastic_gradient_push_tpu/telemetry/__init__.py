@@ -26,6 +26,12 @@ from .comm import (
     encoded_payload_bytes,
     tree_payload_bytes,
 )
+from .metrics import (
+    METRIC_NAMES,
+    MetricsRegistry,
+    request_latency_meter,
+    step_time_meter,
+)
 from .registry import (
     EVENT_KINDS,
     LEGACY_PREFIXES,
@@ -43,6 +49,8 @@ __all__ = [
     "LEGACY_PREFIXES", "JsonlSink", "LoggerCompatSink", "MemorySink",
     "CommModel", "CommAccountant", "tree_payload_bytes",
     "encoded_payload_bytes", "allreduce_bytes", "COMM_CATEGORIES",
+    "METRIC_NAMES", "MetricsRegistry", "step_time_meter",
+    "request_latency_meter",
     "TRACE_FILE", "EVENTS_FILE", "SUPERVISOR_EVENTS_FILE",
     "COORDINATOR_EVENTS_FILE",
 ]

@@ -49,6 +49,7 @@ EVENT_KINDS = frozenset({
     "fleet",        # pod-coordinator decision (assign/go/complete/halt)
     "serve",        # serving-stack lifecycle (reject/summary; serve/)
     "request",      # one completed serve request (typed-only; serve/)
+    "alert",        # SLO rule firing (typed-only; telemetry.aggregate)
 })
 
 SEVERITIES = ("info", "warning", "error")
@@ -69,9 +70,10 @@ LEGACY_PREFIXES = {
 class TelemetryRegistry:
     """Fan-out point for typed events; producers emit, sinks consume."""
 
-    def __init__(self, rank: int = 0, sinks=()):
+    def __init__(self, rank: int = 0, sinks=(), clock=time.time):
         self.rank = int(rank)
         self._sinks = list(sinks)
+        self._clock = clock
         self.counts: dict[str, int] = {}
 
     def add_sink(self, sink) -> None:
@@ -95,7 +97,7 @@ class TelemetryRegistry:
             raise TypeError(f"event data must be a dict, got "
                             f"{type(data).__name__}")
         ev = {"v": SCHEMA_VERSION, "kind": kind,
-              "t": round(time.time(), 6), "rank": self.rank,
+              "t": round(self._clock(), 6), "rank": self.rank,
               "severity": severity, "data": data}
         if step is not None:
             ev["step"] = int(step)

@@ -186,7 +186,12 @@ class SpanTracer:
                 "tid": tids[phase], "args": {"name": phase},
             })
         out.extend(events)
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        # epoch_s anchors this trace's ts=0 on the wall clock so the
+        # cross-host merger (telemetry.tracemerge) can align traces from
+        # different processes onto one timeline; traces written before
+        # the key existed merge at offset 0
+        return {"traceEvents": out, "displayTimeUnit": "ms",
+                "epoch_s": round(self._epoch, 6)}
 
     def write(self, path: str) -> None:
         """Write the trace to ``path`` atomically (write + rename)."""

@@ -32,7 +32,7 @@ from ..parallel.multihost import (
 from ..topology import build_pairing_schedule, build_schedule
 from ..utils import Meter, make_logger
 from ..utils.checkpoint import REQUEUE_EXIT_CODE, ClusterManager
-from ..utils.profiling import StepWatchdog
+from ..utils.profiling import ProfileWindow, StepWatchdog
 from .lr import CosineLRSchedule, LRSchedule, ppi_at_epoch
 from .state import init_train_state, sgd
 from .step import (
@@ -146,6 +146,15 @@ class TrainerConfig:
     # emit a step_stats + comm event every k steps (0 = only the final
     # comm snapshot at exit); requires trace_dir
     metrics_every: int = 0
+    # step-indexed jax.profiler capture (utils/profiling.ProfileWindow):
+    # when set, global steps [profile_start_step, profile_start_step +
+    # profile_steps) are captured as a TensorBoard XPlane dump under
+    # profile_dir.  One-shot and tunnel-guarded: a hung profiler RPC
+    # abandons the window instead of stalling the run.  The dump path is
+    # stamped into run_meta so obsreport/fleetmon can point at it
+    profile_dir: str | None = None
+    profile_start_step: int = 2
+    profile_steps: int = 3
     tag: str = ""
     resume: bool = False
     checkpoint_all: bool = True
@@ -269,6 +278,11 @@ class Trainer:
                                       rank=self.proc_index,
                                       registry=self.telemetry.registry)
                          if config.heartbeat_timeout > 0 else None)
+        # device profiling window around the configured global steps
+        # (no-op when profile_dir is unset — zero hot-path cost)
+        self.profile = ProfileWindow(config.profile_dir,
+                                     start_step=config.profile_start_step,
+                                     num_steps=config.profile_steps)
         self._async_bilat = None  # built per-fit when cfg.bilat_async
         self._warned_prefetch = False
 
@@ -535,6 +549,13 @@ class Trainer:
             "num_epochs": cfg.num_epochs,
             "scan_steps": cfg.scan_steps,
             "comm_model": model.to_dict()}
+        if self.profile.enabled:
+            # where this run's XPlane dump lands (tooling that reads the
+            # run directory can link the profiler capture from run_meta)
+            meta["profile_dir"] = self.profile.profile_dir
+            meta["profile_window"] = [
+                self.profile.start_step,
+                self.profile.start_step + self.profile.num_steps]
         if cfg.fleet:
             # fleet supervision: the coordinator's obsreport timeline
             # maps event streams to hosts through this stamp
@@ -708,6 +729,9 @@ class Trainer:
                 self._async_bilat.stop()
                 self.log.info("async bilateral staleness: "
                               f"{self._async_bilat.staleness_summary()}")
+            # a run that ended inside the capture window still dumps
+            # what it got (and never leaves the profiler accumulating)
+            self.profile.close()
             # write trace.json + the final comm snapshot whatever path
             # exits fit (idempotent; a crashed run still leaves artifacts)
             self.telemetry.finish()
@@ -1038,9 +1062,16 @@ class Trainer:
             guard = (self.watchdog.step()
                      if self.watchdog is not None and timed
                      else contextlib.nullcontext())
+            if self.profile.enabled:
+                # capture window keyed on the GLOBAL step (resume-safe);
+                # a scanned chunk starts/stops around the whole program —
+                # the profiler cannot cut inside one compiled scan
+                self.profile.maybe_start(epoch * itr_per_epoch + i + 1)
             with guard:
                 state, metrics = train_fn(state, x, y)
                 jax.block_until_ready(state)
+            if self.profile.enabled:
+                self.profile.maybe_stop(epoch * itr_per_epoch + i + chunk)
             if self._async_bilat is not None:
                 # wall-clock-async AD-PSGD: expose the fresh params to the
                 # host averaging thread and adopt whatever (stale)

@@ -32,7 +32,8 @@ import time
 from .logging import make_logger
 
 __all__ = ["trace", "start_trace_guarded", "stop_trace_guarded",
-           "StepWatchdog", "HEARTBEAT_TIMEOUT", "fenced_ms"]
+           "ProfileWindow", "StepWatchdog", "HEARTBEAT_TIMEOUT",
+           "fenced_ms"]
 
 HEARTBEAT_TIMEOUT = 300  # seconds, matching distributed.py:36
 
@@ -145,6 +146,75 @@ def trace(log_dir: str, timeout: float = _PROFILER_TIMEOUT):
     finally:
         if started:
             stop_trace_guarded(timeout)
+
+
+class ProfileWindow:
+    """Step-indexed ``jax.profiler`` capture window.
+
+    Both run CLIs used to hand-roll the same start/stop-around-steps
+    dance (with subtly different hang handling); this is the one shared
+    implementation.  Construct it with the run's ``--profile_dir`` (or
+    ``None``, in which case every call is a constant no-op) and call
+    :meth:`maybe_start`/:meth:`maybe_stop` with the GLOBAL step counter
+    around the blocking step call::
+
+        pw = ProfileWindow(profile_dir, start_step=2, num_steps=3)
+        ...
+        pw.maybe_start(gstep)
+        state, metrics = train_fn(state, x, y)
+        jax.block_until_ready(state)
+        pw.maybe_stop(gstep)
+
+    Capture covers steps ``[start_step, start_step + num_steps)``.  The
+    guarded profiler entry points apply (module docstring's tunnel
+    caveat): a hung start is abandoned and the window is never retried —
+    the first failed capture proves this backend can't profile, and a
+    second 60 s stall would just burn another step.
+    """
+
+    def __init__(self, profile_dir: str | None, start_step: int = 2,
+                 num_steps: int = 3, timeout: float = _PROFILER_TIMEOUT):
+        self.profile_dir = profile_dir or None
+        self.start_step = int(start_step)
+        self.num_steps = max(1, int(num_steps))
+        self.timeout = timeout
+        self.active = False
+        self._done = profile_dir is None
+
+    @property
+    def enabled(self) -> bool:
+        return self.profile_dir is not None
+
+    def maybe_start(self, step: int) -> bool:
+        """Start the trace iff ``step`` enters the window; True while a
+        capture is active (idempotent inside the window)."""
+        if self._done or self.active:
+            return self.active
+        if step < self.start_step:
+            return False
+        # one shot only: a window that was skipped past (resume landing
+        # beyond it) or whose start hung must not re-arm later
+        self._done = True
+        if step >= self.start_step + self.num_steps:
+            return False
+        self.active = start_trace_guarded(self.profile_dir, self.timeout)
+        return self.active
+
+    def maybe_stop(self, step: int) -> bool:
+        """Stop the trace once ``step`` completes the window (or
+        unconditionally via :meth:`close`); True if a dump was written."""
+        if not self.active:
+            return False
+        if step < self.start_step + self.num_steps - 1:
+            return False
+        self.active = False
+        return stop_trace_guarded(self.timeout)
+
+    def close(self) -> None:
+        """Stop any still-open capture (run ended inside the window)."""
+        if self.active:
+            self.active = False
+            stop_trace_guarded(self.timeout)
 
 
 def fenced_ms(fn, *args, steps: int = 10, warmup: int = 1) -> float:

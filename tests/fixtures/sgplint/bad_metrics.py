@@ -1,0 +1,48 @@
+"""SGPL014: metric names outside the registered vocabulary.
+
+The fleet exposition namespace is closed: every ``.counter()`` /
+``.gauge()`` / ``.histogram()`` name must appear in a module-level
+``*METRIC_NAMES`` declaration (``telemetry/metrics.py`` in the real
+tree; this fixture carries its own so ``lint_file`` sees a non-empty
+vocabulary).  A literal that is not registered forks the namespace —
+dashboards and SLO rules key on exact names, so the typo'd series
+records forever and nobody watches it.  ``ok_metrics.py`` is the
+registered good twin.
+"""
+
+FLEET_METRIC_NAMES = frozenset({
+    "sgp_steps_total",
+    "sgp_step_time_s",
+    "sgp_ps_mass_err",
+})
+
+# a name routed through a module constant resolves like a literal
+ROGUE_SERIES = "sgp_stps_total"  # the classic fat-finger fork
+
+
+class _Registry:
+    def counter(self, name, value=1):
+        return (name, value)
+
+    def gauge(self, name, value=0.0):
+        return (name, value)
+
+    def histogram(self, name, value=0.0):
+        return (name, value)
+
+
+def record_step(reg: _Registry, dt: float) -> None:
+    # registered names are silent
+    reg.counter("sgp_steps_total")
+    reg.histogram("sgp_step_time_s", dt)
+    # literal never declared anywhere: the fork
+    reg.counter("sgp_step_total")  # EXPECT: SGPL014
+    # same fork, laundered through a module constant
+    reg.counter(ROGUE_SERIES)  # EXPECT: SGPL014
+    reg.gauge("sgp_mass_err", 0.0)  # EXPECT: SGPL014
+
+
+def record_dynamic(reg: _Registry, name: str) -> None:
+    # an unresolvable argument stays silent: precision over recall —
+    # the runtime registry still raises on unregistered names
+    reg.gauge(name, 1.0)

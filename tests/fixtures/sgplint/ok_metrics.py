@@ -1,0 +1,32 @@
+"""SGPL014 good twin: every emitted name is registered.
+
+Same shape as ``bad_metrics.py`` — a module-level ``*METRIC_NAMES``
+declaration plus counter/gauge/histogram emission — but every name
+(literal or constant-routed) appears in the vocabulary, so the AST
+engine is silent.
+"""
+
+FLEET_METRIC_NAMES = frozenset({
+    "sgp_steps_total",
+    "sgp_step_time_s",
+    "sgp_ps_mass_err",
+})
+
+MASS_SERIES = "sgp_ps_mass_err"
+
+
+class _Registry:
+    def counter(self, name, value=1):
+        return (name, value)
+
+    def gauge(self, name, value=0.0):
+        return (name, value)
+
+    def histogram(self, name, value=0.0):
+        return (name, value)
+
+
+def record_step(reg: _Registry, dt: float, err: float) -> None:
+    reg.counter("sgp_steps_total")
+    reg.histogram("sgp_step_time_s", dt)
+    reg.gauge(MASS_SERIES, err)

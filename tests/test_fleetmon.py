@@ -1,0 +1,226 @@
+"""Fleet observability plane: multi-stream merge semantics.
+
+The supervisor's single-stream :class:`EventTailer` contract is pinned
+in ``test_supervise.py``; this module covers what the fleet aggregator
+layers on top — N tailers merged through a per-stream watermark:
+
+* **concurrent writers** — interleaved appends across skewed host
+  streams release in event-time order, with the frontier withholding
+  events a slower stream could still precede;
+* **same-mtime rotation** — a rotated stream (new inode, same size,
+  same mtime) restarts from byte 0: the reset is inode-keyed, never
+  mtime- or size-keyed;
+* **straggler silent mid-merge** — a host that stops emitting is
+  excluded from the frontier after ``silence_s`` of *event time*, so a
+  dead host cannot stall the fleet view, and its late backfill is
+  counted and consumed rather than dropped.
+"""
+
+import json
+import os
+
+from stochastic_gradient_push_tpu.supervise import EventTailer
+from stochastic_gradient_push_tpu.telemetry import (
+    COORDINATOR_EVENTS_FILE,
+    EVENTS_FILE,
+)
+from stochastic_gradient_push_tpu.telemetry.aggregate import (
+    FleetAggregator,
+    SloThresholds,
+)
+
+
+def _append(path, *events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _ev(t, kind="health", host=None, **data):
+    ev = {"v": 1, "t": round(t, 6), "kind": kind, "data": data}
+    if host is not None:
+        ev["host"] = host
+    return ev
+
+
+def _host_stream(run_dir, host):
+    return os.path.join(run_dir, f"host{host}", EVENTS_FILE)
+
+
+def _agg(run_dir, **kw):
+    kw.setdefault("write_alerts", False)
+    return FleetAggregator(str(run_dir), **kw)
+
+
+class TestSameMtimeRotation:
+    def test_rotation_detected_by_inode_not_mtime_or_size(self, tmp_path):
+        path = tmp_path / EVENTS_FILE
+        _append(str(path), _ev(0.1, step=1))
+        tailer = EventTailer(str(path))
+        assert [e["data"]["step"] for e in tailer.poll()] == [1]
+        old = os.stat(path)
+
+        # rotate: a NEW file takes the name with byte-identical size and
+        # the same mtime — only the inode differs.  (A relaunched run
+        # recreating events.jsonl within the filesystem's mtime
+        # granularity looks exactly like this.)
+        repl = tmp_path / "rotated.jsonl"
+        _append(str(repl), _ev(0.2, step=2))
+        assert os.stat(repl).st_size == old.st_size
+        os.utime(repl, ns=(old.st_atime_ns, old.st_mtime_ns))
+        os.replace(repl, path)
+        st = os.stat(path)
+        assert st.st_ino != old.st_ino
+        assert (st.st_size, st.st_mtime_ns) == (old.st_size,
+                                                old.st_mtime_ns)
+
+        # a position-keyed or mtime-keyed reader would see "no change"
+        # and deliver nothing; the inode-keyed reset re-reads from 0
+        assert [e["data"]["step"] for e in tailer.poll()] == [2]
+        assert tailer.skipped == 0
+
+    def test_rotation_mid_merge_rewinds_one_stream_only(self, tmp_path):
+        h0, h1 = _host_stream(tmp_path, 0), _host_stream(tmp_path, 1)
+        _append(h0, _ev(0.1, step=1), _ev(0.2, step=2))
+        _append(h1, _ev(0.2, step=1))
+        agg = _agg(tmp_path)
+        agg.poll()
+
+        # host0 rotates in place with the same size + mtime
+        old = os.stat(h0)
+        repl = os.path.join(os.path.dirname(h0), "repl.jsonl")
+        _append(repl, _ev(0.3, step=3))
+        pad = old.st_size - os.stat(repl).st_size
+        assert pad > 0
+        with open(repl, "a") as f:   # newline padding: size-identical
+            f.write("\n" * pad)
+        os.utime(repl, ns=(old.st_atime_ns, old.st_mtime_ns))
+        os.replace(repl, h0)
+        assert os.stat(h0).st_ino != old.st_ino
+
+        _append(h1, _ev(0.4, step=2))
+        total = agg.drain()
+        agg.close()
+        # every event from both generations of host0 plus host1's two:
+        # the rewind re-read only the rotated stream, dropped nothing
+        assert agg.emitted == 5
+        assert total == 2
+
+
+class TestConcurrentWriters:
+    def test_interleaved_appends_release_in_event_time_order(
+            self, tmp_path):
+        h0, h1 = _host_stream(tmp_path, 0), _host_stream(tmp_path, 1)
+        coord = os.path.join(str(tmp_path), COORDINATOR_EVENTS_FILE)
+        agg = _agg(tmp_path)
+
+        released_t = []
+        orig = agg._consume
+
+        def record(ev):
+            released_t.append(float(ev["t"]))
+            orig(ev)
+
+        agg._consume = record
+
+        # round 1: skewed appends — host1 runs ahead of host0
+        _append(h0, _ev(0.10, host=0), _ev(0.30, host=0))
+        _append(h1, _ev(0.25, host=1), _ev(0.50, host=1))
+        _append(coord, _ev(0.40, kind="rendezvous", phase="call"))
+        agg.poll()
+        # frontier = min watermark = host0 @ 0.30: the 0.40 and 0.50
+        # events stay buffered — host0 could still emit before them
+        assert released_t == [0.10, 0.25, 0.30]
+
+        # round 2: host0 catches up, but the coordinator (quiet since
+        # 0.40, still within silence_s) now gates the frontier — 0.50
+        # stays buffered behind a stream that could yet precede it
+        _append(h0, _ev(0.60, host=0))
+        _append(h1, _ev(0.55, host=1))
+        agg.poll()
+        assert released_t == [0.10, 0.25, 0.30, 0.40]
+
+        agg.drain()
+        agg.close()
+        assert released_t == sorted(released_t)
+        assert agg.emitted == 7
+        assert agg.late_events == 0
+        assert agg.streams == [
+            COORDINATOR_EVENTS_FILE,
+            os.path.join("host0", EVENTS_FILE),
+            os.path.join("host1", EVENTS_FILE)]
+
+    def test_partial_line_from_live_writer_never_splits_an_event(
+            self, tmp_path):
+        # one writer flushes mid-line while the merge polls: the torn
+        # tail must neither parse nor poison later reads
+        h0, h1 = _host_stream(tmp_path, 0), _host_stream(tmp_path, 1)
+        _append(h1, _ev(0.1, host=1))
+        line = json.dumps(_ev(0.15, host=0))
+        os.makedirs(os.path.dirname(h0), exist_ok=True)
+        with open(h0, "w") as f:
+            f.write(line[:12])
+        agg = _agg(tmp_path)
+        agg.poll()
+        # the torn line is buffered unparsed; host0 has produced no
+        # complete event yet, so it has no watermark and cannot gate —
+        # h1's event releases
+        assert agg.emitted == 1
+        with open(h0, "a") as f:
+            f.write(line[12:] + "\n")
+        agg.drain()
+        agg.close()
+        assert agg.emitted == 2
+        assert agg.late_events == 0  # the joined event arrived whole
+        tailers = [s.tailer for s in agg._streams.values()]
+        assert sum(t.skipped for t in tailers) == 0
+
+
+class TestStragglerSilence:
+    def test_silent_stream_leaves_frontier_and_backfill_is_late(
+            self, tmp_path):
+        h0, h1 = _host_stream(tmp_path, 0), _host_stream(tmp_path, 1)
+        thr = SloThresholds(heartbeat_silence_s=10.0)  # isolate merge
+        agg = _agg(tmp_path, silence_s=0.5, thresholds=thr)
+
+        _append(h0, _ev(0.1, host=0))
+        _append(h1, _ev(0.1, host=1))
+        agg.poll()
+        assert agg.emitted == 2
+
+        # host1 dies mid-merge; host0 keeps emitting well past
+        # silence_s of event time
+        _append(h0, _ev(0.4, host=0), _ev(0.9, host=0))
+        agg.poll()
+        # host1's watermark (0.1) lags the fleet max (0.9) by more than
+        # silence_s: it is dropped from the frontier and host0's whole
+        # tail releases — the dead host did not stall the merge
+        assert agg.emitted == 4
+        assert agg.late_events == 0
+
+        # the straggler backfills BEHIND the released frontier: counted
+        # as late, still consumed — totals stay exact
+        _append(h1, _ev(0.5, host=1))
+        agg.poll()
+        agg.close()
+        assert agg.emitted == 5
+        assert agg.late_events == 1
+
+    def test_slow_but_live_stream_still_gates_the_frontier(
+            self, tmp_path):
+        # the dual: within silence_s, a slow host DOES hold events back
+        # (withholding, not reordering, is the merge's failure mode)
+        h0, h1 = _host_stream(tmp_path, 0), _host_stream(tmp_path, 1)
+        agg = _agg(tmp_path, silence_s=5.0)
+        _append(h0, _ev(0.1, host=0))
+        _append(h1, _ev(0.1, host=1), _ev(2.0, host=1))
+        agg.poll()
+        assert agg.emitted == 2          # 2.0 buffered, not released
+        _append(h0, _ev(2.5, host=0))
+        agg.poll()
+        assert agg.emitted == 3          # 2.5 now gated by h1 @ 2.0
+        agg.drain()
+        agg.close()
+        assert agg.emitted == 4
+        assert agg.late_events == 0

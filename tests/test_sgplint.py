@@ -243,6 +243,28 @@ def test_dma_hygiene_fires_on_waitless_kernel():
     assert lint_file(ok, AXES, relto=FIXDIR) == []
 
 
+def test_metric_vocabulary_is_closed():
+    """Satellite: SGPL014 — the exposition namespace is closed.  The
+    bad fixture forks it three ways (raw literal, constant-routed
+    literal, typo'd gauge); the registered good twin is silent; and the
+    repo-level vocabulary discovery actually finds the registry's
+    declarations (a regression here would let the rule pass
+    vacuously, like the axis-vocabulary pin above)."""
+    from stochastic_gradient_push_tpu.analysis.astlint import (
+        collect_metric_vocabulary,
+    )
+
+    bad = os.path.join(FIXDIR, "bad_metrics.py")
+    ok = os.path.join(FIXDIR, "ok_metrics.py")
+    bad_rules = [f.rule for f in lint_file(bad, AXES, relto=FIXDIR)]
+    assert bad_rules == ["SGPL014"] * 3  # literal, constant, typo
+    assert lint_file(ok, AXES, relto=FIXDIR) == []
+
+    vocab = collect_metric_vocabulary([PKG])
+    assert {"sgp_step_time_seconds", "sgp_ps_mass_err",
+            "sgp_alerts_total", "sgp_heartbeat_age_seconds"} <= vocab
+
+
 # -- baseline ratchet ------------------------------------------------------
 
 
