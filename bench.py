@@ -64,8 +64,10 @@ modeled ENCODED bytes (int8 scale overhead included) alongside — the
 calibration artifact for the planner's wire-fraction pricing.
 BENCH_GVA_KERNEL (auto|pallas|xla, also honored by --overlap-vs-sync)
 selects the gossip transport lane and both artifacts stamp the resolved
-``kernel``; the lane moves identical modeled bytes by construction, so
-only measured ms may differ.  Caveat carried from the r04/r05 rounds:
+``kernel``; BENCH_GVA_BUCKETS sets the split transport's per-bucket
+pipelining depth (stamped as ``gossip_buckets``).  Lane and bucketing
+move identical modeled bytes by construction, so only measured ms may
+differ.  Caveat carried from the r04/r05 rounds:
 those headline values are CACHED on-chip captures (live TPU was
 unreachable at bench time), and the pallas kernel lane's measured-ms
 win likewise needs a live-TPU capture — on the CPU test backend the
@@ -402,8 +404,9 @@ def run_measurement() -> dict:
 
 
 def _resolve_bench_kernel():
-    """(KernelLane | None, "pallas" | "xla") from BENCH_GVA_KERNEL —
-    the gossip transport lane for both --gossip-vs-ar and
+    """(KernelLane | None, "pallas" | "xla", buckets) from
+    BENCH_GVA_KERNEL / BENCH_GVA_BUCKETS — the gossip transport lane
+    (and its per-bucket pipelining depth) for both --gossip-vs-ar and
     --overlap-vs-sync.  An explicit ``pallas`` off-TPU runs through the
     Pallas interpreter (correctness lane, honest-but-slow ms); ``auto``
     is the resolver rule (pallas on TPU, xla elsewhere).  The default
@@ -417,7 +420,8 @@ def _resolve_bench_kernel():
     flag = os.environ.get("BENCH_GVA_KERNEL", "xla")
     interpret = flag == "pallas" and jax.default_backend() != "tpu"
     lane = resolve_gossip_kernel(flag, interpret=interpret)
-    return lane, ("pallas" if lane is not None else "xla")
+    buckets = max(1, int(os.environ.get("BENCH_GVA_BUCKETS", "1")))
+    return lane, ("pallas" if lane is not None else "xla"), buckets
 
 
 def run_gossip_vs_ar() -> dict:
@@ -459,7 +463,7 @@ def run_gossip_vs_ar() -> dict:
     warmup = max(1, int(os.environ.get("BENCH_GVA_WARMUP", "3")))
     ga = max(1, int(os.environ.get("BENCH_GVA_GA", "8")))
     topology = os.environ.get("BENCH_GVA_TOPOLOGY", "ring")
-    kernel_lane, kernel_name = _resolve_bench_kernel()
+    kernel_lane, kernel_name, buckets = _resolve_bench_kernel()
     image, classes = 16, 10
 
     mesh = make_gossip_mesh(world)
@@ -515,15 +519,17 @@ def run_gossip_vs_ar() -> dict:
 
     sgp_ms = timed_ms("sgp_ga_steps",
                       sgp(schedule, GOSSIP_AXIS, global_avg_every=ga,
-                          gossip_kernel=kernel_lane))
+                          gossip_kernel=kernel_lane,
+                          gossip_buckets=buckets))
     ar_ms = timed_ms("allreduce_steps", all_reduce(GOSSIP_AXIS))
 
     # model the TIMED ticks: the algorithm's step counter has already
     # advanced `warmup` ticks when the span opens, and global-average
     # firings depend on the absolute tick
     sgp_bytes = CommModel.from_schedule(
-        schedule, payload, global_avg_every=ga).totals(steps,
-                                                       start=warmup)
+        schedule, payload, global_avg_every=ga,
+        gossip_kernel=kernel_name,
+        gossip_buckets=buckets).totals(steps, start=warmup)
     ar_bytes = CommModel.for_allreduce(world, payload).totals(steps)
 
     # wire-dtype sweep: the same gossip step at each codec, measured ms
@@ -548,12 +554,13 @@ def run_gossip_vs_ar() -> dict:
                 f"sgp_ga_steps_{wd}",
                 sgp(schedule, GOSSIP_AXIS, global_avg_every=ga,
                     wire=codec, error_feedback=ef,
-                    gossip_kernel=kernel_lane))
+                    gossip_kernel=kernel_lane,
+                    gossip_buckets=buckets))
         enc = encoded_payload_bytes(params_tmpl, world, codec)
         modeled = CommModel.from_schedule(
             schedule, enc, exact_bytes=payload, global_avg_every=ga,
-            codec=codec, error_feedback=ef,
-            gossip_kernel=kernel_name).totals(steps, start=warmup)
+            codec=codec, error_feedback=ef, gossip_kernel=kernel_name,
+            gossip_buckets=buckets).totals(steps, start=warmup)
         wire_sweep.append({
             "wire_dtype": wd,
             **({"wire_block": wire_block} if wd == "int8" else {}),
@@ -579,6 +586,7 @@ def run_gossip_vs_ar() -> dict:
         # the gossip transport lane that moved the bytes (modeled bytes
         # are lane-independent by construction; only measured ms moves)
         "kernel": kernel_name,
+        "gossip_buckets": buckets,
         "world": world,
         "batch": batch,
         "steps": steps,
@@ -656,19 +664,11 @@ def run_overlap_vs_sync() -> dict:
     warmup = max(1, int(os.environ.get("BENCH_OVS_WARMUP", "4")))
     reps = max(1, int(os.environ.get("BENCH_OVS_REPS", "3")))
     staleness = max(1, int(os.environ.get("BENCH_OVS_STALENESS", "2")))
-    kernel_lane, kernel_name = _resolve_bench_kernel()
-    if kernel_lane is not None:
-        # overlap rounds force the xla lane at the collective seam, so
-        # honoring a pallas request here would time sync-on-pallas
-        # against overlap-on-xla — a cross-lane comparison that no
-        # longer measures overlap at all.  Hold the transport constant:
-        # both timed modes run xla (the pallas lane's own step time is
-        # --gossip-vs-ar's measurement)
-        print("overlap-vs-sync: BENCH_GVA_KERNEL requested the pallas "
-              "lane, but overlap rounds always run xla — timing both "
-              "modes on xla to keep the comparison lane-pure",
-              file=sys.stderr)
-        kernel_lane, kernel_name = None, "xla"
+    # since the start/wait split, overlap rounds ride the requested lane
+    # first-class (gossip_edge_start at the top of the step, the wait at
+    # the bottom), so both timed modes run the SAME transport — the
+    # comparison stays lane-pure without forcing anything
+    kernel_lane, kernel_name, buckets = _resolve_bench_kernel()
     classes = 10
 
     mesh = make_gossip_mesh(world)
@@ -697,9 +697,11 @@ def run_overlap_vs_sync() -> dict:
         return fn, st
 
     modes = {
-        "sync": sgp(schedule, GOSSIP_AXIS, gossip_kernel=kernel_lane),
+        "sync": sgp(schedule, GOSSIP_AXIS, gossip_kernel=kernel_lane,
+                    gossip_buckets=buckets),
         "overlap": sgp(schedule, GOSSIP_AXIS, overlap=True,
-                       staleness=staleness, gossip_kernel=kernel_lane),
+                       staleness=staleness, gossip_kernel=kernel_lane,
+                       gossip_buckets=buckets),
     }
     built = {name: build(alg) for name, alg in modes.items()}
     final_state = {}
@@ -753,14 +755,14 @@ def run_overlap_vs_sync() -> dict:
 
     payload = tree_payload_bytes(built["sync"][1].params, world)
     sync_bytes = CommModel.from_schedule(
-        schedule, payload, gossip_kernel=kernel_name).totals(
-        steps, start=warmup)
-    # overlap rounds force the xla lane at the collective seam, so the
-    # overlap comm model stamps the lane that ACTUALLY ran — not the
-    # requested one (same rule as transport_kernel_name in the trainers)
+        schedule, payload, gossip_kernel=kernel_name,
+        gossip_buckets=buckets).totals(steps, start=warmup)
+    # the split start/wait transport means overlap runs the SAME lane
+    # as sync — the comm model stamps the one lane both modes rode
     over_bytes = CommModel.from_schedule(
         schedule, payload, overlap=True, staleness=staleness,
-        gossip_kernel="xla").totals(steps, start=warmup)
+        gossip_kernel=kernel_name,
+        gossip_buckets=buckets).totals(steps, start=warmup)
 
     out = {
         "metric": "overlap_vs_sync_step_ms",
@@ -770,13 +772,16 @@ def run_overlap_vs_sync() -> dict:
         "speedup_vs_sync": round(sync_ms / overlap_ms, 3)
         if overlap_ms else None,
         "staleness": staleness,
-        # the gossip transport lane BOTH timed modes ran.  Overlap
-        # rounds always resolve to xla at the collective seam (the
-        # fused op cannot hide behind compute), so a pallas request is
-        # forced to xla for the sync mode too — the speedup must
-        # compare like against like.  Bytes are lane-independent either
-        # way; only measured ms may move
+        # the gossip transport lane BOTH timed modes ran.  Since the
+        # start/wait split, overlap rides the requested lane first-class
+        # (the fence between launch and compute is gone), so the speedup
+        # compares like against like by construction.  Bytes are
+        # lane-independent either way; only measured ms may move
         "kernel": kernel_name,
+        # per-bucket pipelining depth of the split transport: >1 breaks
+        # the round into byte-balanced leaf buckets whose start/wait
+        # pairs interleave (bytes identical, only timing may move)
+        "gossip_buckets": buckets,
         "world": world,
         "batch": batch,
         "image": image,
@@ -812,10 +817,10 @@ def run_overlap_vs_sync() -> dict:
                        "on-chip captures, and the pallas lane's "
                        "measured-ms win needs a live-TPU capture (until "
                        "it lands, pallas is opt-in everywhere — the "
-                       "production default is xla, and overlap rounds "
-                       "resolve to xla regardless) — on cpu the kernel "
-                       "runs through the Pallas interpreter "
-                       "(correctness, not speed)")
+                       "production default is xla; since the start/wait "
+                       "split, overlap rounds ride whichever lane is "
+                       "requested) — on cpu the kernel runs through the "
+                       "Pallas interpreter (correctness, not speed)")
     out_path = os.environ.get(
         "BENCH_OVS_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -879,6 +884,11 @@ def overlap_vs_sync_main(selftest: bool) -> int:
         failures.append(
             f"artifact kernel lane {result.get('kernel')!r} missing or "
             "unknown; the transport lane must be stamped (pallas|xla)")
+    if not isinstance(result.get("gossip_buckets"), int) \
+            or result["gossip_buckets"] < 1:
+        failures.append(
+            f"artifact gossip_buckets {result.get('gossip_buckets')!r} "
+            "missing or invalid; the pipelining depth must be stamped")
     if result["consensus_parity_rel"] > 0.05:
         failures.append(
             f"consensus parity {result['consensus_parity_rel']} "
@@ -892,7 +902,8 @@ def overlap_vs_sync_main(selftest: bool) -> int:
           f"{result['value']} ms vs sync {result['sync_step_ms']} ms, "
           f"speedup {result['speedup_vs_sync']}x, parity "
           f"{result['consensus_parity_rel']}, bytes equal, "
-          f"kernel {result['kernel']})", flush=True)
+          f"kernel {result['kernel']}, "
+          f"buckets {result['gossip_buckets']})", flush=True)
     return 0
 
 

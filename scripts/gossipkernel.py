@@ -10,7 +10,11 @@ The selftest pins the interpret-mode kernel on a world-8 virtual CPU
 mesh: the fused remote-DMA transport (ops/gossip_kernel.py) must be
 bit-identical to the XLA ppermute on the f32 passthrough lane and
 within f32 tolerance on the int8 in-kernel dequant lane (same scales,
-same op order), across a chunked payload with a ragged tail; and the
+same op order), across a chunked payload with a ragged tail; the split
+``gossip_edge_start``/``gossip_edge_wait`` pair must equal the fused
+spelling bit-for-bit; one edge-folded (E=2) kernel program must equal
+two sequential single-edge calls (the per-bucket transport shape);
+waiting an empty handle must be the identity; and the
 ``--gossip_kernel pallas`` resolver must reject a non-TPU backend with
 the typed KernelBackendError instead of a Mosaic crash.
 """

@@ -489,6 +489,24 @@ def render(report: dict) -> str:
                    else "")
                 + f"; exact payload {m.get('exact_bytes'):,} B vs "
                   f"encoded {m.get('payload_bytes'):,} B")
+        # the transport shape behind the gossip rounds: which lane
+        # moved the bytes and, for the split start/wait kernel, how the
+        # round was pipelined into byte-balanced buckets.  Bucketing
+        # re-times the wire, never re-prices it — the per-bucket bytes
+        # here are the SAME gossip_wire total, just sliced per round
+        lane = m.get("gossip_kernel", "xla")
+        nb = max(1, int(m.get("gossip_buckets", 1) or 1))
+        rounds = max(1, int(c.get("gossip_rounds") or 1))
+        per_round = by.get("gossip_wire", 0) // rounds
+        if nb > 1:
+            lines.append(
+                f"   transport: {lane} lane, {nb} byte-balanced "
+                f"bucket(s)/round — ~{per_round // nb:,} B in flight "
+                f"per start->wait span (of {per_round:,} B/round)")
+        else:
+            lines.append(
+                f"   transport: {lane} lane, single bucket "
+                f"({per_round:,} B/round per start->wait span)")
         for k, v in sorted(by.items()):
             if v:
                 lines.append(f"   {k:>18}: {v:,}")
@@ -528,7 +546,9 @@ def selftest() -> int:
         schedule = build_schedule(RingGraph(8, peers_per_itr=1))
         payload = 10_000
         model = CommModel.from_schedule(schedule, payload,
-                                        global_avg_every=8)
+                                        global_avg_every=8,
+                                        gossip_kernel="pallas",
+                                        gossip_buckets=3)
         acc = rt.attach_comm(model)
         rt.registry.emit("run_meta", {
             "world": 8, "algorithm": "sgp", "gossip_every": 1,
@@ -638,7 +658,8 @@ def selftest() -> int:
             os.path.join(d, "bench_serve.json"), metrics)
 
         report = build_report(d)
-        print(render(report))
+        rendered = render(report)
+        print(rendered)
 
         ok = True
 
@@ -731,6 +752,22 @@ def selftest() -> int:
                f"{sv}")
         expect(fm["comm"] == report["comm"],
                "fleetmon comm snapshot != obsreport comm snapshot")
+
+        # the transport provenance: the report carries the lane and the
+        # split-kernel bucket depth, renders a per-bucket span line, and
+        # the bucketed model prices EXACTLY like the unbucketed one
+        # (bucketing re-times the wire, never re-prices it)
+        cm = (report["comm"] or {}).get("model") or {}
+        expect(cm.get("gossip_kernel") == "pallas"
+               and cm.get("gossip_buckets") == 3,
+               f"transport stamp: kernel {cm.get('gossip_kernel')!r} "
+               f"buckets {cm.get('gossip_buckets')!r}")
+        expect("3 byte-balanced bucket" in rendered,
+               "per-bucket transport span line missing from report")
+        flat = CommModel.from_schedule(schedule, payload,
+                                       global_avg_every=8)
+        expect(model.totals(num_steps) == flat.totals(num_steps),
+               "bucketed comm model re-priced the wire")
 
         # the analytic gate: reported bytes equal the model's expectation
         want = model.totals(num_steps)

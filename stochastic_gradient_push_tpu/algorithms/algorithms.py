@@ -183,7 +183,7 @@ class PushSumGossip(GossipAlgorithm):
                  gossip_every: int = 1, comm_dtype=None,
                  staleness: int = 1, global_avg_every: int = 0,
                  faults=None, wire=None, error_feedback: bool = False,
-                 gossip_kernel=None):
+                 gossip_kernel=None, gossip_buckets: int = 1):
         self.schedule = schedule
         self.axis_name = axis_name
         self.overlap = overlap
@@ -289,19 +289,28 @@ class PushSumGossip(GossipAlgorithm):
 
             gossip_kernel = resolve_gossip_kernel(gossip_kernel)
         self.gossip_kernel = gossip_kernel
+        # transport bucketing (collectives._transport_plan): the kernel
+        # lane partitions each round's payload into this many contiguous
+        # byte-bounded buckets, each its own start/wait pallas_call pair
+        # — more buckets in flight per overlap round, identical wire
+        # bytes and numerics.  Inert on the XLA lane.
+        if gossip_buckets < 1:
+            raise ValueError("gossip_buckets must be >= 1")
+        self.gossip_buckets = int(gossip_buckets)
 
     @property
     def transport_kernel_name(self) -> str:
         """The transport lane the wire ACTUALLY runs, for telemetry.
-        Two configurations resolve a configured kernel lane back to
-        ``"xla"``: overlap rounds (the fused kernel starts and waits
-        its DMA inside one op, so the collective layer forces the async
-        start/done pair that can hide behind compute — see
-        ``collectives._apply_round``), and a lossy codec with no
-        in-kernel decode spec (``kernel_spec() is None`` pins the XLA
-        path at ``collectives._edge_transport``; a lossless codec
-        resolves to the exact-f32 wire, which the kernel does carry)."""
-        if self.gossip_kernel is None or self.overlap:
+        One configuration resolves a configured kernel lane back to
+        ``"xla"``: a lossy codec with no in-kernel decode spec
+        (``kernel_spec() is None`` pins the XLA path at the
+        ``collectives._round_fn`` transport seam; a lossless codec
+        resolves to the exact-f32 wire, which the kernel does carry).
+        Overlap no longer downgrades: the split start/wait kernel
+        (ops/gossip_kernel.py) issues its remote DMA at launch and
+        lands it at consume, so the pallas lane rides the overlap
+        schedule first-class."""
+        if self.gossip_kernel is None:
             return "xla"
         if (self.wire is not None and self.wire.lossy
                 and self.wire.kernel_spec() is None):
@@ -320,18 +329,24 @@ class PushSumGossip(GossipAlgorithm):
             out = collectives.mix_push_sum(
                 params, ps_weight, phase, self.schedule, self.axis_name,
                 codec=self.wire, faults=self.faults, tick=tick,
-                ef_residual=residual, kernel=self.gossip_kernel)
+                ef_residual=residual, kernel=self.gossip_kernel,
+                buckets=self.gossip_buckets)
             if residual is None:
                 return out[0], out[1], None
             return out
         return (collectives.mix_push_pull(
             params, phase, self.schedule, self.axis_name,
-            codec=self.wire, kernel=self.gossip_kernel), ps_weight, None)
+            codec=self.wire, kernel=self.gossip_kernel,
+            buckets=self.gossip_buckets), ps_weight, None)
 
     def _launch(self, params, ps_weight, rotation, tick, residual):
         """Launch one double-buffered round (collectives.overlap_launch):
         returns ``(local_params, local_w, incoming, new_residual)`` where
-        ``incoming`` is the ``(params, w)`` share to defer in the FIFO.
+        ``incoming`` is the ``(params, w)`` share to defer in the FIFO —
+        a plain tree on the XLA lane, a ``collectives.PendingShares``
+        carrying per-bucket transport handles on the kernel lane (the
+        split start kernel issued its remote DMA here; post_step lands
+        or settles it at the bottom of this same step).
         local = lo·x; incoming = Σ_i ppermute(w_i·x) — their sum is
         exactly the synchronous round, so overlap differs from sync only
         in *when* the incoming share is applied.
@@ -341,13 +356,14 @@ class PushSumGossip(GossipAlgorithm):
             local, incoming = collectives.overlap_launch(
                 tree, rotation, self.schedule, self.axis_name,
                 codec=self.wire, faults=self.faults, tick=tick,
-                kernel=self.gossip_kernel)
+                kernel=self.gossip_kernel, buckets=self.gossip_buckets)
             return local[0], local[1], incoming, None
         full_res = (residual, jax.tree.map(jnp.zeros_like, ps_weight))
         local, incoming, new_res = collectives.overlap_launch(
             tree, rotation, self.schedule, self.axis_name,
             codec=self.wire, faults=self.faults, tick=tick,
-            ef_residual=full_res, kernel=self.gossip_kernel)
+            ef_residual=full_res, kernel=self.gossip_kernel,
+            buckets=self.gossip_buckets)
         return local[0], local[1], incoming, new_res[0]
 
     # -- algorithm slots ---------------------------------------------------
@@ -390,10 +406,15 @@ class PushSumGossip(GossipAlgorithm):
 
             def skip_branch(op):
                 # non-firing step: nothing launches; a zero share rides
-                # the FIFO so the consume clock stays uniform
+                # the FIFO so the consume clock stays uniform.  On the
+                # kernel lane the zero share is a zero PendingShares —
+                # lax.cond arms must hand back the same pytree as the
+                # launch arm (waiting a zero handle lands zero)
                 p, w, r = op
-                return p, w, (self._zeros_like_params(p),
-                              jnp.zeros_like(w)), r
+                return p, w, collectives.empty_incoming(
+                    (p, w), self.schedule, codec=self.wire,
+                    kernel=self.gossip_kernel,
+                    buckets=self.gossip_buckets), r
 
             local_p, local_w, incoming, residual = jax.lax.cond(
                 fire, launch_branch, skip_branch,
@@ -451,14 +472,15 @@ class PushSumGossip(GossipAlgorithm):
         # the step (≙ _query_gossip_queue, distributed.py:336-387:
         # p += r; ps_weight += gossip_ps_weight), launched staleness−1
         # steps ago by pre_step; the freed tail slot takes the next
-        # launch.  The round's ppermute had the whole forward/backward
-        # to complete.
+        # launch.  The round's transport — XLA's async collective
+        # permute or the split kernel's per-bucket remote DMA — had the
+        # whole forward/backward to complete; land_shares folds a plain
+        # share with a tree add and a PendingShares through the wait
+        # kernel (in-VMEM decode + per-edge axpy per bucket).
         tick = as_scalar(phase)
-        in_params, in_w = state.in_flight[0]
-        params = jax.tree.map(lambda p, b: p + b.astype(p.dtype),
-                              params, in_params)
-        ps_weight = state.ps_weight + jnp.reshape(
-            in_w, jnp.shape(state.ps_weight))
+        params, ps_weight = collectives.land_shares(
+            (params, state.ps_weight), state.in_flight[0])
+        ps_weight = jnp.reshape(ps_weight, jnp.shape(state.ps_weight))
         from ..topology.hierarchical import HierarchicalSchedule
 
         if isinstance(self.schedule, HierarchicalSchedule):
@@ -479,9 +501,17 @@ class PushSumGossip(GossipAlgorithm):
             # sgplint: disable=SGPL011 (fired is rank-uniform: step counter + static config)
             params, ps_weight = jax.lax.cond(
                 fired, intra_branch, lambda op: op, (params, ps_weight))
-        empty = (self._zeros_like_params(in_params),
-                 jnp.zeros_like(in_w))
-        in_flight = state.in_flight[1:] + (empty,)
+        # SETTLE every slot this step does not consume: the slot pushed
+        # by pre_step may carry live transport handles (PendingShares),
+        # and those exist strictly inside the step that launched them —
+        # the wait lands here, at the bottom, with the whole step's
+        # compute between start and wait.  Between steps the FIFO holds
+        # plain arrays only, so checkpoints, resharding, drains and the
+        # monitor are bucketing-agnostic.
+        empty = (self._zeros_like_params(params),
+                 jnp.zeros_like(state.ps_weight))
+        in_flight = tuple(collectives.settle_share(s)
+                          for s in state.in_flight[1:]) + (empty,)
         params, ps_weight, in_flight = self._maybe_global_average(
             params, ps_weight, tick + 1, in_flight=in_flight)
         return params, state.replace(phase=phase + 1,
@@ -583,7 +613,7 @@ class PushPullGossip(PushSumGossip):
     def __init__(self, schedule: GossipSchedule, axis_name: str,
                  overlap: bool = False, staleness: int = 1,
                  global_avg_every: int = 0, faults=None,
-                 gossip_kernel=None):
+                 gossip_kernel=None, gossip_buckets: int = 1):
         if not schedule.regular:
             raise ValueError("D-PSGD requires a regular schedule "
                              "(doubly-stochastic mixing)")
@@ -599,7 +629,8 @@ class PushPullGossip(PushSumGossip):
         super().__init__(schedule, axis_name, overlap=overlap,
                          track_weight=overlap, staleness=staleness,
                          global_avg_every=global_avg_every,
-                         gossip_kernel=gossip_kernel)
+                         gossip_kernel=gossip_kernel,
+                         gossip_buckets=gossip_buckets)
 
 
 class BilateralGossip(GossipAlgorithm):
@@ -638,30 +669,34 @@ def sgp(schedule: GossipSchedule, axis_name: str,
         comm_dtype=None, staleness: int = 1,
         global_avg_every: int = 0, faults=None, wire=None,
         error_feedback: bool = False,
-        gossip_kernel=None) -> PushSumGossip:
+        gossip_kernel=None, gossip_buckets: int = 1) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=overlap,
                          gossip_every=gossip_every, comm_dtype=comm_dtype,
                          staleness=staleness,
                          global_avg_every=global_avg_every, faults=faults,
                          wire=wire, error_feedback=error_feedback,
-                         gossip_kernel=gossip_kernel)
+                         gossip_kernel=gossip_kernel,
+                         gossip_buckets=gossip_buckets)
 
 
 def osgp(schedule: GossipSchedule, axis_name: str,
-         staleness: int = 1, gossip_kernel=None) -> PushSumGossip:
+         staleness: int = 1, gossip_kernel=None,
+         gossip_buckets: int = 1) -> PushSumGossip:
     return PushSumGossip(schedule, axis_name, overlap=True,
                          staleness=staleness,
-                         gossip_kernel=gossip_kernel)
+                         gossip_kernel=gossip_kernel,
+                         gossip_buckets=gossip_buckets)
 
 
 def dpsgd(schedule: GossipSchedule, axis_name: str,
           overlap: bool = False, staleness: int = 1,
           global_avg_every: int = 0, faults=None,
-          gossip_kernel=None) -> PushPullGossip:
+          gossip_kernel=None, gossip_buckets: int = 1) -> PushPullGossip:
     return PushPullGossip(schedule, axis_name, overlap=overlap,
                           staleness=staleness,
                           global_avg_every=global_avg_every, faults=faults,
-                          gossip_kernel=gossip_kernel)
+                          gossip_kernel=gossip_kernel,
+                          gossip_buckets=gossip_buckets)
 
 
 def adpsgd(pairing: np.ndarray, axis_name: str) -> BilateralGossip:

@@ -30,7 +30,7 @@ from .findings import Finding
 __all__ = ["LintCache", "content_sha", "CACHE_SCHEMA"]
 
 # bump whenever interface extraction or any engine's rules change shape
-CACHE_SCHEMA = 2  # 2: ModuleInterface.metrics + SGPL014 env keying
+CACHE_SCHEMA = 3  # 3: FuncInfo transport_sites (SGPL013 start/wait)
 
 DEFAULT_CACHE_PATH = os.path.join("artifacts", "sgplint_cache.json")
 

@@ -65,6 +65,13 @@ SEQ_COLLECTIVES = {
 # joins the sequence vocabulary under its own name
 _KERNEL_COLLECTIVE = "gossip_edge_axpy"
 
+# the split transport pair (ops/gossip_kernel.py): every handle a
+# ``gossip_edge_start`` returns must reach a ``gossip_edge_wait`` —
+# possibly at a separate call site, which is exactly the cross-call
+# hazard Engine 3's closure tracks (``_check_transport_handles``)
+_TRANSPORT_START = "gossip_edge_start"
+_TRANSPORT_WAIT = "gossip_edge_wait"
+
 # host-side reads that drain the dispatch queue (the SGPL012 escape
 # hatch): any of these in a dispatch loop's body serializes it
 _BLOCKING_CALLS = {
@@ -112,6 +119,13 @@ class FuncInfo:
     blocking: bool = False             # direct blocking read in body
     branch_sites: list = dataclasses.field(default_factory=list)
     loop_sites: list = dataclasses.field(default_factory=list)
+    # direct gossip_edge_wait call in this body (the terminal the
+    # cross-call start-without-wait check searches the closure for)
+    has_transport_wait: bool = False
+    # unwaited gossip_edge_start sites whose handle does NOT escape to
+    # a caller: {line, var, calls: [refs the handle flows into],
+    # discarded, suppressed} — judged interprocedurally in Engine 3
+    transport_sites: list = dataclasses.field(default_factory=list)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -312,6 +326,7 @@ class _Extractor:
                             for d in node.decorator_list))
         self.iface.functions[qual] = info
         self._walk_body(node.body, info, prefix=f"{prefix}{node.name}.")
+        self._scan_transport_starts(node, info)
 
     # -- expression flow ---------------------------------------------------
 
@@ -350,6 +365,10 @@ class _Extractor:
         else:
             if self._is_blocking(node, name):
                 fn.blocking = True
+            if name is not None and (
+                    name == _TRANSPORT_WAIT
+                    or name.endswith("." + _TRANSPORT_WAIT)):
+                fn.has_transport_wait = True
             ref = _call_ref(self.mod, node.func)
             if ref is not None and not self._is_benign(name):
                 fn.events.append(("call", line) + ref)
@@ -445,6 +464,99 @@ class _Extractor:
             "calls": calls, "blocking": blocking,
             "suppressed": self.mod.suppressed(node.lineno, "SGPL012"),
         })
+
+    # -- SGPL013 split-transport handle flow -------------------------------
+
+    def _scan_transport_starts(self, node, info: FuncInfo) -> None:
+        """Record this body's ``gossip_edge_start`` handles that neither
+        reach a local ``gossip_edge_wait`` nor escape to the caller.
+
+        Escape analysis is precision-first: a handle returned (bare, or
+        inside a returned structure), re-bound into a structure, or
+        handed to an *unresolvable* call (``self.m(h)``, ``lst.append``)
+        is the consumer's problem and silences the site.  What remains
+        — a discarded start result, a handle that dies locally, or one
+        flowing only into resolvable callees — is judged in Engine 3,
+        where the closure decides whether any callee reaches a wait
+        (the cross-call half of the split start/wait contract)."""
+        nodes: list = []
+
+        def collect(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue  # nested defs get their own scan
+                nodes.append(child)
+                collect(child)
+
+        collect(node)
+
+        def matches(call, suffix):
+            name = self.mod.canonical(call.func)
+            return name is not None and (
+                name == suffix or name.endswith("." + suffix))
+
+        binds: dict[str, int] = {}
+        for n in nodes:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and isinstance(n.value, ast.Call) \
+                    and matches(n.value, _TRANSPORT_START):
+                binds[n.targets[0].id] = n.lineno
+            elif isinstance(n, ast.Expr) and isinstance(n.value, ast.Call) \
+                    and matches(n.value, _TRANSPORT_START):
+                info.transport_sites.append({
+                    "line": n.lineno, "var": None, "calls": [],
+                    "discarded": True,
+                    "suppressed": self.mod.suppressed(n.lineno,
+                                                      "SGPL013")})
+        if not binds:
+            return
+
+        def loose_names(expr):
+            """Names in ``expr`` NOT inside a call — call arguments are
+            accounted for by the consumer scan, a bare name escapes."""
+            out: set[str] = set()
+
+            def walk(e):
+                if e is None or isinstance(e, ast.Call):
+                    return
+                if isinstance(e, ast.Name):
+                    out.add(e.id)
+                for c in ast.iter_child_nodes(e):
+                    walk(c)
+
+            walk(expr)
+            return out
+
+        for var, line in binds.items():
+            waited = escaped = False
+            calls: list = []
+            for n in nodes:
+                if isinstance(n, ast.Call):
+                    args = list(n.args) + [k.value for k in n.keywords]
+                    if not any(isinstance(a, ast.Name) and a.id == var
+                               for a in args):
+                        continue
+                    if matches(n, _TRANSPORT_WAIT):
+                        waited = True
+                        break
+                    ref = _call_ref(self.mod, n.func)
+                    if ref is None:
+                        escaped = True  # opaque consumer owns it
+                    else:
+                        calls.append(list(ref))
+                elif isinstance(n, (ast.Return, ast.Assign)) \
+                        and getattr(n, "value", None) is not None \
+                        and var in loose_names(n.value):
+                    escaped = True
+            if waited or escaped:
+                continue
+            info.transport_sites.append({
+                "line": line, "var": var, "calls": calls,
+                "discarded": False,
+                "suppressed": self.mod.suppressed(line, "SGPL013")})
 
     # -- SGPL013 collective_id + kernel hygiene ----------------------------
 

@@ -96,13 +96,20 @@ RULES: dict[str, Rule] = {
     "SGPL013": Rule(
         "Pallas DMA/semaphore hygiene: an async copy without a .wait() "
         "on every control path, barrier-semaphore signal/wait arity "
-        "mismatch, or a collective_id integer literal reused across "
+        "mismatch, a collective_id integer literal reused across "
         "call sites (distinct collectives sharing a hardware slot "
-        "corrupt each other's semaphores)",
+        "corrupt each other's semaphores), or a gossip_edge_start "
+        "transport handle that never reaches gossip_edge_wait — "
+        "tracked across call sites through the call-graph closure, "
+        "since the split start/wait pair is designed to meet in "
+        "different functions",
         "wait every DMA you start on every path that starts it, match "
-        "barrier waits to the number of signals, and derive "
-        "collective_id from the COLLECTIVE_ID_SLOTS pool "
-        "(ops/gossip_kernel.py is the reference shape)"),
+        "barrier waits to the number of signals, derive "
+        "collective_id from the COLLECTIVE_ID_SLOTS pool, and route "
+        "every start handle to a gossip_edge_wait — locally, in a "
+        "callee, or by returning it to the owner that waits it "
+        "(ops/gossip_kernel.py + parallel/collectives.py are the "
+        "reference shape)"),
     "SGPL014": Rule(
         "metric name is not in the registered vocabulary: a "
         ".counter()/.gauge()/.histogram() call whose name string is not "

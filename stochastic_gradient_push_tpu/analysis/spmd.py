@@ -19,10 +19,16 @@ file, which is what lets the lint cache skip unchanged files):
 * **SGPL013 Pallas DMA/semaphore hygiene** — kernel-local checks
   (every started async copy waited on all control paths, barrier
   signal/wait arity) are pre-computed at extraction; the whole-program
-  half checked here is ``collective_id`` reuse: the same integer
+  halves checked here are (a) ``collective_id`` reuse: the same integer
   literal at two call sites aliases two logically distinct collectives
   onto one hardware slot, so ids must come from the
-  ``COLLECTIVE_ID_SLOTS`` pool instead (the PR 15 finding).
+  ``COLLECTIVE_ID_SLOTS`` pool instead (the PR 15 finding); and (b)
+  cross-call start-without-wait: a ``gossip_edge_start`` transport
+  handle that neither escapes to its caller nor reaches a
+  ``gossip_edge_wait`` through any resolvable callee — the split
+  start/wait pair may meet at separate call sites, so the search runs
+  over the closure, and a handle that dies unwaited leaves the remote
+  DMA landing into freed buffers.
 
 Precision over recall throughout: a site is only reported when every
 callable involved resolves statically; opaque targets (``self.m()``,
@@ -60,6 +66,8 @@ def analyze_program(graph: CallGraph,
             _check_dispatch_loops(graph, apath, rel, func, findings)
         for line, msg in iface.kernel_findings:
             findings.append(Finding(rel, line, "SGPL013", msg))
+        for func in iface.functions.values():
+            _check_transport_handles(graph, apath, rel, func, findings)
     _check_collective_id_reuse(graph, relto, findings)
     return sorted(findings)
 
@@ -135,7 +143,68 @@ def _check_dispatch_loops(graph, apath, rel, func, findings) -> None:
             f"block_until_ready inside the loop"))
 
 
-# -- SGPL013 (whole-program half) --------------------------------------------
+# -- SGPL013 (whole-program halves) ------------------------------------------
+
+
+def _wait_reachable(graph, apath, func, seen) -> bool:
+    """True when this function, or any function reachable through its
+    resolvable call events, directly calls ``gossip_edge_wait``."""
+    key = (apath, func.qualname)
+    if key in seen:
+        return False
+    seen.add(key)
+    if getattr(func, "has_transport_wait", False):
+        return True
+    for ev in func.events:
+        if ev[0] != "call":
+            continue
+        for tpath, g in graph.resolve_call(apath, tuple(ev[2:])):
+            if _wait_reachable(graph, tpath, g, seen):
+                return True
+    return False
+
+
+def _check_transport_handles(graph, apath, rel, func, findings) -> None:
+    """Cross-call start-without-wait: extraction already filtered out
+    handles waited locally or escaping to a caller; what reaches here
+    is judged through the closure.  Precision over recall: a handle
+    flowing into a call the graph cannot resolve is silenced — only a
+    handle that provably dies (discarded result, no consumer at all,
+    or every consumer resolvable and wait-free) is reported."""
+    for site in getattr(func, "transport_sites", []):
+        if site["suppressed"]:
+            continue
+        if site["discarded"]:
+            findings.append(Finding(
+                rel, site["line"], "SGPL013",
+                "result of gossip_edge_start is discarded — the "
+                "transport handle can never reach gossip_edge_wait, so "
+                "the remote DMA lands into buffers that are already "
+                "dead"))
+            continue
+        unresolved = False
+        reachable = False
+        for ref in site["calls"]:
+            targets = graph.resolve_call(apath, tuple(ref))
+            if not targets:
+                unresolved = True
+                break
+            if any(_wait_reachable(graph, tpath, g, set())
+                   for tpath, g in targets):
+                reachable = True
+                break
+        if reachable or unresolved:
+            continue
+        where = ("it flows into no callee and does not escape"
+                 if not site["calls"] else
+                 "no callee it flows into reaches gossip_edge_wait, "
+                 "and it does not escape to a caller")
+        findings.append(Finding(
+            rel, site["line"], "SGPL013",
+            f"transport handle '{site['var']}' from gossip_edge_start "
+            f"is never waited: {where} — the split start/wait pair "
+            "must meet, possibly at a separate call site; wait the "
+            "handle or return it to the owner that will"))
 
 
 def _check_collective_id_reuse(graph, relto, findings) -> None:

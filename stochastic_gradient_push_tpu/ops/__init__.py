@@ -9,12 +9,18 @@ from .gossip_kernel import (
     GOSSIP_KERNELS,
     KernelBackendError,
     KernelLane,
+    TransportHandle,
+    empty_transport_handle,
     gossip_edge_axpy,
+    gossip_edge_start,
+    gossip_edge_wait,
     resolve_gossip_kernel,
     resolve_use_pallas,
 )
 
 __all__ = ["flash_attention", "flash_attention_forward",
            "flash_attention_backward", "GOSSIP_KERNELS",
-           "KernelBackendError", "KernelLane", "gossip_edge_axpy",
+           "KernelBackendError", "KernelLane", "TransportHandle",
+           "empty_transport_handle", "gossip_edge_axpy",
+           "gossip_edge_start", "gossip_edge_wait",
            "resolve_gossip_kernel", "resolve_use_pallas"]

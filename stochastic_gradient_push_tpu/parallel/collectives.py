@@ -47,6 +47,24 @@ perturbation of the network mean, never a bias.  Composition rules:
 * NaN corruption drills poison the residual along with the payload —
   the ``ef_residual_rms`` health signal (resilience/monitor.py) makes
   that visible the same step.
+
+Transport lanes: every real payload leaf crosses the wire either as an
+XLA ``lax.ppermute`` + receiver decode, or through the split Pallas
+transport (ops/gossip_kernel.py).  On the kernel lane the round's
+payload leaves are packed into ``buckets`` contiguous byte-bounded
+transport buckets; each bucket is ONE :func:`~..ops.gossip_kernel.\
+gossip_edge_start` program serving all ``peers_per_itr`` edges (its own
+``collective_id`` slot), and its matching wait —
+:func:`~..ops.gossip_kernel.gossip_edge_wait` — decodes in VMEM and
+folds the edges into the accumulator.  A synchronous round waits every
+bucket immediately; a split round (:func:`overlap_launch`) returns the
+live handles inside a :class:`PendingShares` so the caller can run the
+whole step's compute between the start and the wait — the pipelined
+per-bucket form of "The Algorithm of Pipelined Gossiping".  Everything
+upstream of the pack — sender multiply, fault masks, EF injection,
+``codec.encode`` — is shared per (edge, leaf), so the EF residual
+telescopes against the union of the bucketed sends and fault masks key
+on the launch tick whatever step lands the bucket.
 """
 
 from __future__ import annotations
@@ -71,6 +89,10 @@ __all__ = [
     "mix_bilat",
     "allreduce_mean",
     "allreduce_sum",
+    "PendingShares",
+    "land_shares",
+    "settle_share",
+    "empty_incoming",
 ]
 
 
@@ -110,49 +132,222 @@ def _resolve_codec(codec, comm_dtype):
     return codec
 
 
-def _edge_transport(acc, msg, parts, codec, dests, pairs, axis_name,
-                    kernel, leaf_slot=0):
-    """One edge's wire for one leaf: accumulate the received (decoded)
-    contribution into ``acc``.
+def _kernel_spec(send_codec):
+    """The in-kernel decode spec the kernel lane would run for this
+    resolved codec: the exact wire is the f32 passthrough; a lossy codec
+    with no spec pins the XLA path (``transport_kernel_name`` stamps
+    it)."""
+    if send_codec is None:
+        return wire_mod.F32.kernel_spec()
+    return send_codec.kernel_spec()
 
-    The single seam where the two transport lanes meet: the XLA lane
-    ppermutes each encoded part and decodes at the receiver; the Pallas
-    lane (``kernel`` — an :class:`~..ops.gossip_kernel.KernelLane`)
-    hands the same encoded parts to the fused remote-DMA kernel, which
-    decodes in VMEM and performs the mixing axpy in-place
-    (ops/gossip_kernel.py).  Everything upstream — the sender multiply,
-    fault masks, EF residual injection, ``codec.encode`` — is shared, so
-    the EF residual always telescopes against the same sent bytes and
-    the lanes stay bit-aligned.  A codec with no in-kernel decode spec
-    falls back to the XLA lane.
 
-    ``leaf_slot`` (the leaf's flatten position) derives the kernel's
-    barrier ``collective_id``: same-leaf calls are ordered by their
-    accumulator data dependency, so distinct leaves — the only calls
-    that could execute concurrently — cycle distinct ids.
+def _transport_plan(leaves, spec, num_buckets):
+    """Static transport plan for the kernel lane: partition the payload
+    (``size > 1``) leaf slots into ``num_buckets`` contiguous,
+    byte-bounded buckets — the OSGP reference's message bucketing, made
+    static.  Scalar leaves (the push-sum weight) never enter a bucket:
+    they take the exact-f32 ppermute lane.
+
+    Returns a tuple of buckets, each a tuple of ``(slot, n, padded)``
+    triples — ``slot`` the leaf's flatten position, ``n`` its element
+    count, ``padded`` its packed length (int8 leaves pad to whole codec
+    blocks so per-row scales stay block-local across the concat).
+    Nested tuples of ints: hashable, so the plan can ride pytree aux
+    data (:class:`PendingShares`) and must compare equal across the
+    phase ``lax.switch`` branches (it is phase-independent by
+    construction).  ``()`` when no leaf qualifies — the caller then
+    skips the kernel entirely.  A dtype change between adjacent leaves
+    forces a bucket boundary (one bucket ships ONE packed accumulator),
+    so pathological mixed-dtype trees may exceed ``num_buckets``.
     """
-    if kernel is not None:
-        from ..ops import gossip_kernel as gk
+    block = spec.block if spec.kind == "int8" else None
+    items = []
+    for j, a in enumerate(leaves):
+        n = int(np.prod(jnp.shape(a), dtype=np.int64))
+        if n <= 1:
+            continue
+        padded = n if block is None else -(-n // int(block)) * int(block)
+        items.append((j, n, padded, jnp.asarray(a).dtype))
+    if not items:
+        return ()
+    k = max(1, min(int(num_buckets), len(items)))
+    total = float(sum(p for _, _, p, _ in items))
+    buckets, cur, cum = [], [], 0.0
+    for idx, (j, n, padded, dt) in enumerate(items):
+        if cur and dt != cur[-1][3]:
+            buckets.append(cur)
+            cur = []
+        cur.append((j, n, padded, dt))
+        cum += padded
+        left = len(items) - idx - 1
+        need = k - len(buckets) - 1
+        if left > 0 and need > 0 and (
+                left == need
+                or cum >= total * (len(buckets) + 1) / k):
+            buckets.append(cur)
+            cur = []
+    if cur:
+        buckets.append(cur)
+    return tuple(tuple((j, n, p) for j, n, p, _ in b) for b in buckets)
 
-        spec = (codec.kernel_spec() if codec is not None
-                else wire_mod.F32.kernel_spec())
-        if spec is not None:
-            return gk.gossip_edge_axpy(
-                acc, parts if codec is not None else (msg,), dests,
-                axis_name, spec, interpret=kernel.interpret,
-                chunk_elems=kernel.chunk_elems,
-                collective_id=leaf_slot % gk.COLLECTIVE_ID_SLOTS)
-    if codec is not None:
-        recv = codec.decode(tuple(lax.ppermute(p, axis_name, pairs)
-                                  for p in parts), msg)
-    else:
-        recv = lax.ppermute(msg, axis_name, pairs)
-    return acc + recv
+
+def _pack_bucket(bucket, sent, kind, ne):
+    """Stack one bucket's buffered encoded parts into the kernel's
+    ``[E, ...]`` convention: concatenate the bucket's leaves within each
+    edge (int8 along the block-row axis — every leaf is a whole number
+    of blocks, so scales stay block-local), then stack the
+    ``peers_per_itr`` edges in front."""
+    if kind == "int8":
+        q = jnp.stack([
+            jnp.concatenate([sent[j][i][0] for j, _, _ in bucket], axis=0)
+            for i in range(ne)])
+        s = jnp.stack([
+            jnp.concatenate([sent[j][i][1] for j, _, _ in bucket], axis=0)
+            for i in range(ne)])
+        return (q, s)
+    v = jnp.stack([
+        jnp.concatenate([sent[j][i][0].reshape(-1) for j, _, _ in bucket])
+        for i in range(ne)])
+    return (v,)
+
+
+def _pack_acc(bucket, acc):
+    """One bucket's packed flat accumulator: each leaf raveled and
+    zero-padded to its packed length (the pad lanes receive decode(0)
+    == 0 from the wire, so they stay zero and are sliced away)."""
+    segs = []
+    for j, n, padded in bucket:
+        seg = acc[j].reshape(-1)
+        if padded != n:
+            seg = jnp.pad(seg, (0, padded - n))
+        segs.append(seg)
+    return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+
+
+def _unpack_acc(bucket, flat, acc):
+    """Scatter a waited bucket back into the accumulator leaves (inverse
+    of :func:`_pack_acc`); mutates ``acc`` in place."""
+    off = 0
+    for j, n, padded in bucket:
+        acc[j] = flat[off:off + n].reshape(jnp.shape(acc[j]))
+        off += padded
+
+
+@jax.tree_util.register_pytree_node_class
+class PendingShares:
+    """One split round's deferred incoming share on the kernel lane.
+
+    :func:`overlap_launch` with an active Pallas ``kernel`` returns this
+    in place of the plain incoming tree: ``inc`` carries the
+    jnp-transported leaves (the exact-f32 scalar lane — the push-sum
+    weight — and anything the kernel does not carry; bucketed slots are
+    zeros there), ``handles`` one live
+    :class:`~..ops.gossip_kernel.TransportHandle` per transport bucket
+    holding landed WIRE bytes, and the aux ``plan`` the static bucket
+    layout (:func:`_transport_plan`).  A registered pytree, so it rides
+    the overlap FIFO slot through the step, ``lax.cond`` arms and the
+    phase ``lax.switch`` (the plan is phase-independent).  Consume it
+    exactly once — :func:`land_shares` into the target tree, or
+    :func:`settle_share` to a plain share — to preserve push-sum mass.
+    """
+
+    def __init__(self, inc, handles, plan):
+        self.inc = inc
+        self.handles = tuple(handles)
+        self.plan = plan
+
+    def tree_flatten(self):
+        return (self.inc, self.handles), self.plan
+
+    @classmethod
+    def tree_unflatten(cls, plan, children):
+        inc, handles = children
+        return cls(inc, handles, plan)
+
+
+def land_shares(tree, incoming):
+    """Fold one incoming gossip share into ``tree`` — the single consume
+    seam of the overlap FIFO.  A plain share (the XLA lane, settled or
+    zero slots, world 1) is an elementwise tree add.  A
+    :class:`PendingShares` lands each transport bucket through the wait
+    kernel (:func:`~..ops.gossip_kernel.gossip_edge_wait`): pull the
+    landed chunks, decode the wire in VMEM, fold all ``peers_per_itr``
+    edges into the packed accumulator — the same per-edge fold order as
+    the synchronous kernel round — then scatter the result back into the
+    leaves; the non-bucketed ``inc`` slots (the scalar ps-weight lane)
+    are plain adds."""
+    if not isinstance(incoming, PendingShares):
+        return jax.tree.map(
+            lambda p, b: p + jnp.asarray(b, jnp.asarray(p).dtype),
+            tree, incoming)
+    from ..ops import gossip_kernel as gk
+
+    leaves, treedef = jax.tree.flatten(tree)
+    inc = jax.tree.leaves(incoming.inc)
+    if len(inc) != len(leaves):
+        raise ValueError(
+            "pending share does not mirror the target tree "
+            f"({len(inc)} vs {len(leaves)} leaves)")
+    bucketed = {j for bucket in incoming.plan for j, _, _ in bucket}
+    out = [a if j in bucketed
+           else a + jnp.asarray(inc[j], jnp.asarray(a).dtype)
+           for j, a in enumerate(leaves)]
+    for handle, bucket in zip(incoming.handles, incoming.plan):
+        flat = gk.gossip_edge_wait(handle, _pack_acc(bucket, out))
+        _unpack_acc(bucket, flat, out)
+    return jax.tree.unflatten(treedef, out)
+
+
+def settle_share(incoming):
+    """Materialize a :class:`PendingShares` into the plain share tree
+    the FIFO stores between steps: land it into zeros.  ``post_step``
+    settles every slot it does not consume at the bottom of the step
+    that launched it, so checkpoints, resharding, drains and the
+    monitor only ever see plain arrays — a live transport handle exists
+    strictly inside one jitted step.  Plain shares pass through
+    untouched."""
+    if not isinstance(incoming, PendingShares):
+        return incoming
+    return land_shares(jax.tree.map(jnp.zeros_like, incoming.inc),
+                       incoming)
+
+
+def empty_incoming(tree, schedule, codec=None, comm_dtype=None,
+                   kernel=None, buckets=1):
+    """The zero incoming share structurally matching what
+    :func:`overlap_launch` returns for this configuration — the
+    thinning skip branch (``PushSumGossip.pre_step``) must hand
+    ``lax.cond`` the same pytree as the launch arm.  Plain zeros on the
+    XLA lane (also world 1, a specless lossy codec, or a tree with no
+    payload leaves); on the kernel lane a zero :class:`PendingShares`
+    (waiting a zero handle lands a zero contribution: decode(0) == 0
+    for every codec)."""
+    zeros = jax.tree.map(jnp.zeros_like, tree)
+    if kernel is None or schedule.world_size == 1:
+        return zeros
+    if isinstance(schedule, HierarchicalSchedule):
+        # only the delegate (inter) share rides in flight
+        schedule = schedule.inter_schedule
+    spec = _kernel_spec(_resolve_codec(codec, comm_dtype))
+    if spec is None:
+        return zeros
+    plan = _transport_plan(jax.tree.leaves(tree), spec, buckets)
+    if not plan:
+        return zeros
+    from ..ops import gossip_kernel as gk
+
+    handles = tuple(
+        gk.empty_transport_handle(
+            spec, sum(p for _, _, p in bucket), schedule.peers_per_itr,
+            interpret=kernel.interpret, chunk_elems=kernel.chunk_elems)
+        for bucket in plan)
+    return PendingShares(zeros, handles, plan)
 
 
 def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
               comm_dtype=None, faults=None, codec=None, split=False,
-              kernel=None):
+              kernel=None, buckets=1):
     """Build the mixing function for one static phase of the schedule.
 
     Returns ``mix(tree, tick, residual) -> (out, new_residual)``;
@@ -163,7 +358,10 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
     ``lo·x`` (reabsorbed fault weight included) and the received peer
     contributions ``Σᵢ ppermute(wᵢ·x)``, whose sum IS the synchronous
     round.  The split form is the double-buffered overlap round's launch
-    half: the caller applies ``local`` now and defers ``incoming``.
+    half: the caller applies ``local`` now and defers ``incoming`` — a
+    plain tree on the XLA lane, a :class:`PendingShares` carrying live
+    transport handles on the kernel lane (fold it with
+    :func:`land_shares` / :func:`settle_share`).
 
     ``codec`` (a :class:`~.wire.WireCodec`; ``comm_dtype`` is the
     deprecated bf16-only alias) compresses the wire payload: real
@@ -189,10 +387,16 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
     lane stays finite so ps-weight telemetry survives the fault.
 
     ``kernel`` (an :class:`~..ops.gossip_kernel.KernelLane`, or None for
-    the XLA ppermute lane) routes real payload leaves through the fused
-    Pallas transport (:func:`_edge_transport`): remote DMA + in-VMEM
-    decode + mixing axpy in one op.  Scalar leaves — the push-sum
-    weight — never enter the kernel.
+    the XLA ppermute lane) routes real payload leaves through the split
+    Pallas transport: the per-(edge, leaf) loop below only encodes and
+    buffers; after the loop each of the ``buckets`` transport buckets
+    (:func:`_transport_plan`) issues ONE
+    :func:`~..ops.gossip_kernel.gossip_edge_start` serving all
+    ``peers_per_itr`` edges, and is folded by the matching wait —
+    immediately for a synchronous round, deferred inside a
+    :class:`PendingShares` for ``split=True`` (the overlap launch the
+    split exists for).  Scalar leaves — the push-sum weight — never
+    enter the kernel.
     """
     lo_table = schedule.self_weight[phase_idx]
     edge_w = schedule.edge_weights[phase_idx]
@@ -221,6 +425,16 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
         # keeps the undelivered weight, it is never in flight
         inc = [jnp.zeros_like(a) for a in leaves] if split else None
         acc = inc if split else out
+        # kernel lane: a static transport plan buckets the payload
+        # leaves; the (edge, leaf) loop below then only ENCODES and
+        # buffers into `sent` — the remote DMA is issued per bucket
+        # after the loop.  An empty plan (specless codec, no payload
+        # leaves, kernel off) leaves `sent` empty and every leaf on the
+        # XLA path.
+        spec = _kernel_spec(send_codec) if kernel is not None else None
+        plan = (_transport_plan(leaves, spec, buckets)
+                if spec is not None else ())
+        sent = {j: [] for bucket in plan for j, _, _ in bucket}
         corrupt = (faults.corrupt_at(tick, axis_name)
                    if faults is not None and faults.any_corruption else None)
         for i in range(schedule.peers_per_itr):
@@ -250,15 +464,18 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                     msg = jnp.where(keep > 0, msg, jnp.zeros_like(msg))
                 if send_codec is not None and msg.size > 1:
                     parts = send_codec.encode(msg)
-                    acc[j] = _edge_transport(acc[j], msg, parts,
-                                             send_codec, perms[i], pairs,
-                                             axis_name, kernel,
-                                             leaf_slot=j)
+                    if j in sent:
+                        sent[j].append(parts)
+                    else:
+                        acc[j] = acc[j] + send_codec.decode(
+                            tuple(lax.ppermute(p, axis_name, pairs)
+                                  for p in parts), msg)
                     if res_in is not None:
                         # quantization error of what was attempted on the
                         # wire (zero for a dropped edge: Q(0) == 0) —
                         # computed from the SAME encoded parts both
-                        # transport lanes ship
+                        # transport lanes ship, so the residual
+                        # telescopes against the union of bucketed sends
                         q_err = msg - send_codec.decode(parts, msg)
                         if inject:
                             # carry rule: when this rank did not put its
@@ -271,9 +488,11 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                         else:
                             err[j] = err[j] + q_err
                 elif msg.size > 1:
-                    acc[j] = _edge_transport(acc[j], msg, None, None,
-                                             perms[i], pairs, axis_name,
-                                             kernel, leaf_slot=j)
+                    if j in sent:
+                        sent[j].append((msg,))
+                    else:
+                        acc[j] = acc[j] + lax.ppermute(msg, axis_name,
+                                                       pairs)
                 else:
                     # scalar (ps-weight) lane: exact f32 ppermute in BOTH
                     # transport lanes — bit-identical by construction
@@ -285,11 +504,37 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
                 drop_w = w_i * (1.0 - keep)
                 for j, a in enumerate(leaves):
                     out[j] = out[j] + a * drop_w.astype(a.dtype)
+        handles = []
+        if plan:
+            from ..ops import gossip_kernel as gk
+
+            ne = schedule.peers_per_itr
+            dests = np.stack([np.asarray(perms[i]) for i in range(ne)])
+            for b, bucket in enumerate(plan):
+                handle = gk.gossip_edge_start(
+                    _pack_bucket(bucket, sent, spec.kind, ne), dests,
+                    axis_name, spec,
+                    n_decoded=sum(p for _, _, p in bucket),
+                    interpret=kernel.interpret,
+                    chunk_elems=kernel.chunk_elems,
+                    collective_id=b % gk.COLLECTIVE_ID_SLOTS)
+                if split:
+                    # overlap launch: the handle rides the FIFO; the
+                    # caller waits it at the bottom of the step
+                    handles.append(handle)
+                else:
+                    # synchronous round: wait immediately — decode in
+                    # VMEM, fold all edges into the packed accumulator
+                    flat = gk.gossip_edge_wait(handle,
+                                               _pack_acc(bucket, acc))
+                    _unpack_acc(bucket, flat, acc)
         new_res = (jax.tree.unflatten(jax.tree.structure(residual), err)
                    if res_in is not None else None)
         if split:
-            return (jax.tree.unflatten(treedef, out),
-                    jax.tree.unflatten(treedef, inc)), new_res
+            incoming = jax.tree.unflatten(treedef, inc)
+            if plan:
+                incoming = PendingShares(incoming, handles, plan)
+            return (jax.tree.unflatten(treedef, out), incoming), new_res
         return jax.tree.unflatten(treedef, out), new_res
 
     return mix
@@ -297,7 +542,7 @@ def _round_fn(schedule: GossipSchedule, phase_idx: int, axis_name: str,
 
 def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
                    axis_name: str, comm_dtype=None, codec=None,
-                   kernel=None):
+                   kernel=None, buckets=1):
     """One compiled hierarchical round: leader ppermute, then the exact
     intra-slice average as ONE grouped ``psum`` over the slice sub-axis
     (ICI-local; the ``slice_size − 1`` rotate-permutations of the table
@@ -318,7 +563,8 @@ def _hier_round_fn(hsched: HierarchicalSchedule, round_idx: int,
     already and stays one.
     """
     inter = _round_fn(hsched.inter_schedule, round_idx, axis_name,
-                      comm_dtype, codec=codec, kernel=kernel)
+                      comm_dtype, codec=codec, kernel=kernel,
+                      buckets=buckets)
 
     def mix(tree, tick, residual):
         t, new_res = inter(tree, tick, residual)
@@ -342,7 +588,7 @@ def intra_average(tree, hsched: HierarchicalSchedule, axis_name: str):
 
 def _synth_round_fn(ssched: SynthesizedSchedule, phase_idx: int,
                     axis_name: str, comm_dtype=None, codec=None,
-                    kernel=None):
+                    kernel=None, buckets=1):
     """One compiled synthesized phase: an edge phase is one ``ppermute``
     round through the compact per-phase tables (full wire-codec path),
     a psum phase is ONE grouped ``lax.psum`` over the spec's equal rank
@@ -365,12 +611,13 @@ def _synth_round_fn(ssched: SynthesizedSchedule, phase_idx: int,
 
         return mix
     return _round_fn(ssched.edge_phase_schedule(phase_idx), 0, axis_name,
-                     comm_dtype, codec=codec, kernel=kernel)
+                     comm_dtype, codec=codec, kernel=kernel,
+                     buckets=buckets)
 
 
 def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
                  comm_dtype=None, faults=None, tick=None, codec=None,
-                 ef_residual=None, kernel=None):
+                 ef_residual=None, kernel=None, buckets=1):
     """One synchronous gossip round over an arbitrary pytree.
 
     Computes ``lo * x + Σ_i ppermute(w_i * x, perm_i(phase))`` — the
@@ -403,20 +650,26 @@ def gossip_round(tree, phase, schedule: GossipSchedule, axis_name: str,
 
     ``kernel`` (an :class:`~..ops.gossip_kernel.KernelLane`; resolve the
     CLI flag with :func:`~..ops.gossip_kernel.resolve_gossip_kernel`)
-    routes real payload leaves through the fused Pallas remote-DMA
+    routes real payload leaves through the split Pallas remote-DMA
     transport instead of ``lax.ppermute`` + decode; None is the XLA
-    lane.  Numerics are lane-independent (pinned by the kernel parity
-    tests); scalar leaves ship the same exact ppermute either way.
+    lane.  ``buckets`` partitions the payload into that many contiguous
+    byte-bounded transport buckets (:func:`_transport_plan`), each ONE
+    start/wait pallas_call pair serving all ``peers_per_itr`` edges
+    with its own ``collective_id`` slot — total wire bytes are
+    unchanged, only the pipelining granularity.  Numerics are lane- and
+    bucket-independent (pinned by the kernel parity tests); scalar
+    leaves ship the same exact ppermute either way.
     """
     mixed, new_res = _apply_round(tree, phase, schedule, axis_name,
                                   comm_dtype, faults, tick, codec,
-                                  ef_residual, split=False, kernel=kernel)
+                                  ef_residual, split=False, kernel=kernel,
+                                  buckets=buckets)
     return mixed if ef_residual is None else (mixed, new_res)
 
 
 def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
                    comm_dtype=None, faults=None, tick=None, codec=None,
-                   ef_residual=None, kernel=None):
+                   ef_residual=None, kernel=None, buckets=1):
     """Launch half of the double-buffered overlap round.
 
     Issues round ``phase``'s ``ppermute`` NOW — called at the TOP of the
@@ -446,17 +699,24 @@ def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
       ICI-local psum stays synchronous — it cannot ride in flight).
 
     Returns ``(local, incoming)``, or ``(local, incoming, new_residual)``
-    when ``ef_residual`` is given.  ``kernel`` is accepted for interface
-    parity with :func:`gossip_round` but overlap rounds always resolve
-    to the XLA ppermute lane: the fused kernel starts and waits its
-    remote DMA inside one op, which would serialize the transport this
-    launch exists to hide — XLA's async collective-permute start/done
-    pair is what actually overlaps with the step's compute (numerics
-    are lane-independent, so the round is unchanged).
+    when ``ef_residual`` is given.  On the XLA lane ``incoming`` is a
+    plain tree; with ``kernel`` (a
+    :class:`~..ops.gossip_kernel.KernelLane`) it is a
+    :class:`PendingShares` whose per-bucket transport handles carry the
+    round's wire — the split start/wait kernel issues its remote DMA
+    HERE, at the top of the step, and the caller folds the landed
+    buckets with :func:`land_shares` (or :func:`settle_share`) at the
+    bottom, so the in-VMEM decode + axpy win rides the overlap instead
+    of being forced back to the ppermute lane.  ``buckets`` sets the
+    pipelining granularity (multiple buckets in flight per round, each
+    its own ``collective_id`` slot); every launched share must be
+    landed exactly once, whatever the bucket count — push-sum mass is
+    the invariant SGPV106 pins.
     """
     out, new_res = _apply_round(tree, phase, schedule, axis_name,
                                 comm_dtype, faults, tick, codec,
-                                ef_residual, split=True, kernel=kernel)
+                                ef_residual, split=True, kernel=kernel,
+                                buckets=buckets)
     local, incoming = out
     if ef_residual is None:
         return local, incoming
@@ -464,19 +724,15 @@ def overlap_launch(tree, phase, schedule: GossipSchedule, axis_name: str,
 
 
 def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
-                 tick, codec, ef_residual, split, kernel=None):
+                 tick, codec, ef_residual, split, kernel=None, buckets=1):
     """Shared dispatch of one (possibly split) gossip round: validation,
-    per-phase branch construction, traced-phase ``lax.switch``."""
-    if split and kernel is not None:
-        # overlap launches force the XLA ppermute lane: the fused
-        # Pallas kernel starts AND waits its remote DMA inside one op,
-        # so routed through the launch half it would serialize the very
-        # transport the overlap schedule exists to hide behind the
-        # step's compute.  XLA's async collective-permute start/done
-        # pair is what actually rides behind the forward/backward; the
-        # kernel lane stays a sync-round transport until it is split
-        # into separate start/wait calls (ROADMAP carried item)
-        kernel = None
+    per-phase branch construction, traced-phase ``lax.switch``.  The
+    kernel lane rides ``split`` rounds too — the start/wait split is
+    exactly what lets the remote DMA launch at the top of the step and
+    land at the bottom (the old forced-xla overlap downgrade is gone).
+    """
+    if buckets < 1:
+        raise ValueError("buckets must be >= 1")
     if isinstance(schedule, HierarchicalSchedule) and faults is not None:
         # static configuration error: reject before any axis
         # introspection so the message survives outside a mesh context
@@ -515,7 +771,7 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
         # psum); the traced phase index selects among them like any
         # flat rotation
         branches = [_synth_round_fn(schedule, p, axis_name, comm_dtype,
-                                    codec, kernel=kernel)
+                                    codec, kernel=kernel, buckets=buckets)
                     for p in range(schedule.num_phases)]
         idx = as_scalar(phase) % schedule.num_phases
         fault_tick = None
@@ -526,11 +782,12 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
             # runs intra_average when the share is consumed
             branches = [_round_fn(schedule.inter_schedule, q, axis_name,
                                   comm_dtype, codec=codec, split=True,
-                                  kernel=kernel)
+                                  kernel=kernel, buckets=buckets)
                         for q in range(rounds)]
         else:
             branches = [_hier_round_fn(schedule, q, axis_name, comm_dtype,
-                                       codec, kernel=kernel)
+                                       codec, kernel=kernel,
+                                       buckets=buckets)
                         for q in range(rounds)]
         idx = as_scalar(phase) % rounds
         fault_tick = None
@@ -540,7 +797,8 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
         else:
             fault_tick = None
         branches = [_round_fn(schedule, p, axis_name, comm_dtype, faults,
-                              codec, split=split, kernel=kernel)
+                              codec, split=split, kernel=kernel,
+                              buckets=buckets)
                     for p in range(schedule.num_phases)]
         idx = as_scalar(phase) % schedule.num_phases
 
@@ -553,7 +811,7 @@ def _apply_round(tree, phase, schedule, axis_name, comm_dtype, faults,
 
 def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
                  axis_name: str, comm_dtype=None, faults=None, tick=None,
-                 codec=None, ef_residual=None, kernel=None):
+                 codec=None, ef_residual=None, kernel=None, buckets=1):
     """Push-sum round: jointly mixes parameters and the push-sum weight.
 
     The reference appends the scalar ps-weight to the flat payload only when
@@ -574,17 +832,18 @@ def mix_push_sum(params, ps_weight, phase, schedule: GossipSchedule,
     if ef_residual is None:
         return gossip_round(tree, phase, schedule, axis_name,
                             comm_dtype=comm_dtype, faults=faults,
-                            tick=tick, codec=codec, kernel=kernel)
+                            tick=tick, codec=codec, kernel=kernel,
+                            buckets=buckets)
     full_res = (ef_residual, jax.tree.map(jnp.zeros_like, ps_weight))
     (p, w), (new_res, _) = gossip_round(
         tree, phase, schedule, axis_name, comm_dtype=comm_dtype,
         faults=faults, tick=tick, codec=codec, ef_residual=full_res,
-        kernel=kernel)
+        kernel=kernel, buckets=buckets)
     return p, w, new_res
 
 
 def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str,
-                  comm_dtype=None, codec=None, kernel=None):
+                  comm_dtype=None, codec=None, kernel=None, buckets=1):
     """Doubly-stochastic (D-PSGD) round.
 
     With uniform mixing on a regular graph the mixing matrix is doubly
@@ -597,7 +856,8 @@ def mix_push_pull(params, phase, schedule: GossipSchedule, axis_name: str,
         raise ValueError("push-pull requires a regular schedule "
                          "(doubly-stochastic mixing)")
     return gossip_round(params, phase, schedule, axis_name,
-                        comm_dtype=comm_dtype, codec=codec, kernel=kernel)
+                        comm_dtype=comm_dtype, codec=codec, kernel=kernel,
+                        buckets=buckets)
 
 
 def mix_bilat(params, phase, pairing: np.ndarray, axis_name: str):

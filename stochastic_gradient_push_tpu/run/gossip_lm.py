@@ -592,13 +592,15 @@ def main(argv=None):
                       wire=get_codec(args.wire_dtype, args.wire_block),
                       error_feedback=bool(args.error_feedback),
                       global_avg_every=gae, faults=faults,
-                      gossip_kernel=args.gossip_kernel)
+                      gossip_kernel=args.gossip_kernel,
+                      gossip_buckets=args.gossip_buckets)
         else:
             reject_push_sum_wire_knobs(args)
             alg = dpsgd(schedule, GOSSIP_AXIS, overlap=sb(args.overlap),
                         staleness=max(1, args.staleness),
                         global_avg_every=gae, faults=faults,
-                        gossip_kernel=args.gossip_kernel)
+                        gossip_kernel=args.gossip_kernel,
+                        gossip_buckets=args.gossip_buckets)
 
     tx = sgd(momentum=args.momentum, weight_decay=args.weight_decay,
              nesterov=sb(args.nesterov))
@@ -718,7 +720,8 @@ def main(argv=None):
                 overlap=getattr(alg, "overlap", False),
                 staleness=getattr(alg, "staleness", 1),
                 gossip_kernel=getattr(alg, "transport_kernel_name",
-                                      "xla"))
+                                      "xla"),
+                gossip_buckets=getattr(alg, "gossip_buckets", 1))
         rt.attach_comm(comm_model)
     if rt.enabled:
         run_meta = {

@@ -106,8 +106,18 @@ def add_kernel_flag(p: argparse.ArgumentParser) -> None:
                         "capture — opt in with pallas/auto.  Numerics "
                         "are lane-independent (CI bit-compares them); "
                         "the push-sum weight lane ships exact f32 "
-                        "either way, and overlap rounds run xla "
-                        "regardless")
+                        "either way, and overlap rounds ride the "
+                        "kernel first-class (split start/wait "
+                        "transport)")
+    p.add_argument("--gossip_buckets", default=1, type=int,
+                   help="kernel-lane transport pipelining: partition "
+                        "the payload into this many contiguous "
+                        "byte-bounded buckets, one start/wait kernel "
+                        "program per bucket, so later buckets' remote "
+                        "DMAs overlap earlier buckets' decode.  "
+                        "Ignored on the xla lane; never changes bytes "
+                        "or numerics (parity-pinned).  Default 1 (one "
+                        "program for the whole payload)")
 
 
 def resolve_kernel_flag(args) -> None:
@@ -121,6 +131,9 @@ def resolve_kernel_flag(args) -> None:
         resolve_gossip_kernel(args.gossip_kernel)
     except KernelBackendError as e:
         raise SystemExit(f"--gossip_kernel pallas: {e}")
+    if getattr(args, "gossip_buckets", 1) < 1:
+        raise SystemExit("--gossip_buckets must be >= 1, got "
+                         f"{args.gossip_buckets}")
 
 
 def add_synth_flags(p: argparse.ArgumentParser) -> None:
@@ -605,6 +618,7 @@ def parse_config(argv=None):
         wire_block=args.wire_block,
         error_feedback=bool(args.error_feedback),
         gossip_kernel=args.gossip_kernel,
+        gossip_buckets=args.gossip_buckets,
         per_rank_csv=_str_bool(args.per_rank_csv),
         heartbeat_timeout=args.heartbeat_timeout,
         global_avg_every=args.global_avg_every or 0,

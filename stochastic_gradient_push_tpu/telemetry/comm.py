@@ -179,6 +179,12 @@ class CommModel:
     # interconnect are identical by construction — so this only stamps
     # which kernel moved them (obsreport and the bench artifacts read it)
     gossip_kernel: str = "xla"
+    # kernel-lane pipelining provenance: the payload is partitioned
+    # into this many contiguous transport buckets, each its own
+    # start/wait kernel program.  A pure partition of the SAME bytes —
+    # re-times the wire, never re-prices it — so like the lane it only
+    # stamps how the payload was pipelined
+    gossip_buckets: int = 1
     wire_bytes_per_phase: tuple[int, ...] = ()
     ici_bytes_per_phase: tuple[int, ...] = ()
     dcn_bytes_per_phase: tuple[int, ...] = ()
@@ -195,7 +201,8 @@ class CommModel:
                       error_feedback: bool = False,
                       overlap: bool = False,
                       staleness: int = 1,
-                      gossip_kernel: str = "xla") -> "CommModel":
+                      gossip_kernel: str = "xla",
+                      gossip_buckets: int = 1) -> "CommModel":
         """Model a push-sum/D-PSGD run over ``schedule``.
 
         ``payload_bytes`` must already be the ENCODED wire payload
@@ -302,6 +309,7 @@ class CommModel:
                        overlap=bool(overlap),
                        staleness=max(1, int(staleness)),
                        gossip_kernel=str(gossip_kernel),
+                       gossip_buckets=max(1, int(gossip_buckets)),
                        wire_bytes_per_phase=tuple(wire_l),
                        ici_bytes_per_phase=tuple(ici_l),
                        dcn_bytes_per_phase=tuple(dcn_l),
@@ -338,6 +346,7 @@ class CommModel:
                        overlap=bool(overlap),
                        staleness=max(1, int(staleness)),
                        gossip_kernel=str(gossip_kernel),
+                       gossip_buckets=max(1, int(gossip_buckets)),
                        wire_bytes_per_phase=tuple(wire_l),
                        ici_bytes_per_phase=tuple(ici_l),
                        dcn_bytes_per_phase=tuple(dcn_l),
@@ -376,6 +385,7 @@ class CommModel:
                    overlap=bool(overlap),
                    staleness=max(1, int(staleness)),
                    gossip_kernel=str(gossip_kernel),
+                   gossip_buckets=max(1, int(gossip_buckets)),
                    wire_bytes_per_phase=tuple(wire_l),
                    ici_bytes_per_phase=tuple(ici_l),
                    dcn_bytes_per_phase=tuple(dcn_l),
@@ -485,6 +495,7 @@ class CommModel:
                 "overlap": self.overlap,
                 "staleness": self.staleness,
                 "gossip_kernel": self.gossip_kernel,
+                "gossip_buckets": self.gossip_buckets,
                 "ici_bytes_per_phase": list(self.ici_bytes_per_phase),
                 "dcn_bytes_per_phase": list(self.dcn_bytes_per_phase)}
 

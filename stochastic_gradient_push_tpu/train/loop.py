@@ -93,9 +93,17 @@ class TrainerConfig:
     # "auto" picks pallas on TPU.  Default "xla" (ppermute+decode): the
     # kernel is parity-pinned through the Pallas interpreter but has no
     # live-TPU capture yet — opt in explicitly until that lands, then
-    # flip this to "auto" (ROADMAP carried item).  Overlap rounds run
-    # "xla" regardless (the fused op cannot hide behind compute)
+    # flip this to "auto" (ROADMAP carried item).  Overlap rounds ride
+    # the kernel lane first-class: the split start/wait transport
+    # launches the remote DMA at the top of the step and lands it at
+    # the bottom, so compute actually hides the wire
     gossip_kernel: str = "xla"
+    # kernel-lane transport pipelining: partition the payload into this
+    # many contiguous byte-bounded buckets, one start/wait kernel
+    # program per bucket (own collective_id slot), so later buckets'
+    # DMAs overlap earlier buckets' decode.  1 = one program for the
+    # whole payload; never changes bytes or math (parity-pinned)
+    gossip_buckets: int = 1
     bilat: bool = False                       # AD-PSGD family
     # AD-PSGD with REAL wall-clock asynchrony: the compiled step carries
     # no collective; a host thread averages bilaterally off the hot path
@@ -460,14 +468,16 @@ class Trainer:
                        staleness=staleness,
                        global_avg_every=cfg.global_avg_every,
                        faults=faults,
-                       gossip_kernel=cfg.gossip_kernel)
+                       gossip_kernel=cfg.gossip_kernel,
+                       gossip_buckets=cfg.gossip_buckets)
         if cfg.gossip_every != 1:
             raise ValueError("gossip_every is a push-sum knob")
         return dpsgd(schedule, axis, overlap=cfg.overlap,
                      staleness=staleness,
                      global_avg_every=cfg.global_avg_every,
                      faults=faults,
-                     gossip_kernel=cfg.gossip_kernel)
+                     gossip_kernel=cfg.gossip_kernel,
+                     gossip_buckets=cfg.gossip_buckets)
 
     def _train_fn(self, ppi: int, itr_per_epoch: int, scan: int = 1):
         """Compiled step for a peers-per-itr value; each distinct
@@ -538,7 +548,8 @@ class Trainer:
                 overlap=getattr(alg, "overlap", False),
                 staleness=getattr(alg, "staleness", 1),
                 gossip_kernel=getattr(alg, "transport_kernel_name",
-                                      "xla"))
+                                      "xla"),
+                gossip_buckets=getattr(alg, "gossip_buckets", 1))
         self.telemetry.attach_comm(model)
         meta = {
             "world": self.gossip_world, "algorithm": alg_name,

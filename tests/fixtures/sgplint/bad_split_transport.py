@@ -1,0 +1,32 @@
+"""SGPL013 cross-call start-without-wait: the split transport pair.
+
+``gossip_edge_start`` returns a live transport handle — remote-DMA
+payloads landed into buffers the handle owns — and every handle must
+reach a ``gossip_edge_wait``: locally, in a resolvable callee at a
+separate call site, or by escaping to the caller that owns it.  Three
+shapes where none of that happens: a discarded start result, a handle
+that dies in scope, and a handle flowing only into a callee that never
+waits.  ``ok_split_transport.py`` is the silent twin.
+"""
+
+from stochastic_gradient_push_tpu.ops import gossip_kernel as gk
+
+
+def fire_and_forget(parts, dests, axis, spec):
+    gk.gossip_edge_start(parts, dests, axis, spec)  # EXPECT: SGPL013
+    return None
+
+
+def dies_in_scope(parts, dests, axis, spec, acc):
+    handle = gk.gossip_edge_start(parts, dests, axis, spec)  # EXPECT: SGPL013
+    return acc
+
+
+def _log_only(handle):
+    return str(handle)
+
+
+def wrong_consumer(parts, dests, axis, spec, acc):
+    h = gk.gossip_edge_start(parts, dests, axis, spec)  # EXPECT: SGPL013
+    _log_only(h)
+    return acc

@@ -1,0 +1,30 @@
+"""Silent twin of ``bad_split_transport.py``: legitimate handle flows.
+
+The three ways a ``gossip_edge_start`` handle is allowed to travel —
+waited in the same body (the synchronous kernel round), waited in a
+resolvable callee at a *separate* call site (the cross-call pairing
+Engine 3's closure tracks), and escaped to the caller inside a
+returned structure (the overlap FIFO: the consumer that lands the
+share owns the wait).  Zero findings expected.
+"""
+
+from stochastic_gradient_push_tpu.ops import gossip_kernel as gk
+
+
+def sync_round(parts, dests, axis, spec, acc):
+    h = gk.gossip_edge_start(parts, dests, axis, spec)
+    return gk.gossip_edge_wait(h, acc)
+
+
+def _land(handle, acc):
+    return gk.gossip_edge_wait(handle, acc)
+
+
+def split_round(parts, dests, axis, spec, acc):
+    h = gk.gossip_edge_start(parts, dests, axis, spec)
+    return _land(h, acc)
+
+
+def launch_only(parts, dests, axis, spec, inc):
+    h = gk.gossip_edge_start(parts, dests, axis, spec)
+    return (inc, h)  # the FIFO slot's consumer waits it

@@ -1,5 +1,7 @@
-"""Fused Pallas gossip kernel (ops/gossip_kernel.py): kernel-vs-XLA
-bit-parity, chunking, resolver contracts, and flag plumbing.
+"""Split Pallas gossip transport (ops/gossip_kernel.py — paired
+start/wait ops plus the fused axpy composition): kernel-vs-XLA
+bit-parity across sync, overlap and bucketed rounds, chunking,
+resolver contracts, and flag plumbing.
 
 The parity sweep runs both transport lanes of the SAME algorithm
 configuration on the world-8 CPU mesh — the kernel through the Pallas
@@ -104,21 +106,23 @@ class TestResolvers:
         assert sgp(sched, GOSSIP_AXIS,
                    gossip_kernel=lane).gossip_kernel is lane
 
-    def test_overlap_resolves_to_xla_lane(self):
-        # the fused kernel starts and waits its DMA inside one op, so
-        # overlap rounds force the XLA ppermute lane (the only
-        # transport whose async start/done pair can hide behind
-        # compute); telemetry must stamp what actually runs
+    def test_overlap_keeps_the_kernel_lane(self):
+        # the split start/wait kernel issues its remote DMA at launch
+        # and lands it at consume, so overlap rounds ride the pallas
+        # lane first-class — the old forced-xla downgrade is gone and
+        # telemetry must stamp the lane that actually runs
         sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
         lane = KernelLane(interpret=True)
         sync_alg = sgp(sched, GOSSIP_AXIS, gossip_kernel=lane)
         over_alg = sgp(sched, GOSSIP_AXIS, gossip_kernel=lane,
                        overlap=True, staleness=2)
         assert sync_alg.transport_kernel_name == "pallas"
-        assert over_alg.transport_kernel_name == "xla"
-        # the configured lane itself is preserved for introspection
+        assert over_alg.transport_kernel_name == "pallas"
         assert over_alg.gossip_kernel is lane
         assert sgp(sched, GOSSIP_AXIS).transport_kernel_name == "xla"
+        assert sgp(sched, GOSSIP_AXIS,
+                   overlap=True, staleness=2).transport_kernel_name \
+            == "xla"
 
     def test_specless_codec_resolves_to_xla_lane(self):
         # a lossy codec with no in-kernel decode spec pins the XLA path
@@ -208,6 +212,56 @@ class TestFlagPlumbing:
             "gossip_kernel"] == "xla"
 
 
+# -- chunk layout edge cases (the split path computes layouts per
+# transport bucket, so every ragged shape below now also reaches the
+# kernel through bucketed rounds) -------------------------------------------
+
+
+class TestChunkLayout:
+    def _layout(self, *a):
+        from stochastic_gradient_push_tpu.ops.gossip_kernel import (
+            _chunk_layout)
+
+        return _chunk_layout(*a)
+
+    def test_ragged_tail(self):
+        # 300 elems over 128-elem chunks: 2 full + 1 ragged; the pad is
+        # bounded by one chunk's tail
+        assert self._layout(300, None, 128) == (128, 128, 3)
+
+    def test_payload_smaller_than_one_chunk(self):
+        # the chunk shrinks to the payload — a huge chunk target must
+        # never allocate (or pad to) more than the payload itself
+        # (companion of the 4 GB-pad pin in the axpy parametrization)
+        assert self._layout(33, None, 1 << 30) == (33, 33, 1)
+
+    def test_int8_block7_chunks_are_whole_blocks(self):
+        # 300 elems in 7-wide blocks: 43 scale rows; a 64-elem chunk
+        # target holds 9 whole blocks — scales stay chunk-local, the
+        # ragged row count never splits a block across chunks
+        rows, c, nb = self._layout(300, 7, 64)
+        assert (rows, c, nb) == (9, 63, 5)
+        assert rows * nb >= 43
+
+    def test_payload_smaller_than_one_block(self):
+        assert self._layout(3, 7, 64) == (1, 7, 1)
+
+    def test_scalar_leaf_is_rejected(self):
+        # the transport plan must route scalar leaves (the ps-weight
+        # lane) to the exact-f32 ppermute — reaching the kernel with
+        # one is a plan bug, not a layout to accommodate
+        from stochastic_gradient_push_tpu.ops.gossip_kernel import (
+            _chunk_layout)
+
+        for bad in (0, -1):
+            with pytest.raises(ValueError, match="ppermute lane"):
+                _chunk_layout(bad, None, 128)
+
+    def test_chunk_elems_validated(self):
+        with pytest.raises(ValueError, match="chunk_elems"):
+            self._layout(16, None, 0)
+
+
 # -- the kernel itself ------------------------------------------------------
 
 
@@ -282,7 +336,7 @@ def test_compiled_mode_kernel_carries_the_entry_barrier():
             return gossip_edge_axpy(
                 xr * 0.25, codec.encode(xr), dests, GOSSIP_AXIS,
                 codec.kernel_spec(), interpret=interpret,
-                chunk_elems=128, collective_id=3)[None]
+                chunk_elems=128, collective_id=5)[None]
         return inner
 
     x = np.zeros((WORLD, 300), np.float32)
@@ -311,12 +365,13 @@ def test_dests_must_be_a_permutation():
 
 
 def _run_rounds(schedule, kernel, codec=None, ef=False, faults=None,
-                thin=1, overlap=False, staleness=1, leaf=96):
+                thin=1, overlap=False, staleness=1, buckets=1, leaf=96):
     """ROUNDS gossip steps of one configured PushSumGossip on one
     transport lane; returns (params tree, ps-weight trajectory)."""
     alg = sgp(schedule, GOSSIP_AXIS, wire=codec, error_feedback=ef,
               faults=faults, gossip_every=thin, overlap=overlap,
-              staleness=staleness, gossip_kernel=kernel)
+              staleness=staleness, gossip_kernel=kernel,
+              gossip_buckets=buckets)
 
     def step(p, g):
         p, g = alg.pre_step(p, g)
@@ -340,13 +395,14 @@ def _run_rounds(schedule, kernel, codec=None, ef=False, faults=None,
 
 def test_parity_sweep_kernel_vs_xla():
     """The acceptance sweep: {f32, bf16, int8} × {EF on/off} × {plain,
-    drop fault, thinning} × {sync, overlap staleness 2}, kernel lane vs
-    XLA lane.  ps-weight trajectories bit-identical; params within f32
-    tolerance (FMA fusion on the fallback lane is the only slack).
-    The overlap rows pin the forced resolution to the XLA lane (the
-    fused op cannot hide behind compute, so overlap launches drop the
-    kernel at the collective seam) — the flag must still compose
-    cleanly with overlap and stay exact.
+    drop fault, thinning} × {sync, overlap staleness 2} × {1, 3
+    transport buckets}, kernel lane vs XLA lane.  ps-weight
+    trajectories bit-identical; params within f32 tolerance (FMA fusion
+    on the fallback lane is the only slack).  The overlap rows now run
+    the REAL kernel lane — the split start/wait transport launches its
+    per-bucket remote DMA at the top of the step and lands it at the
+    bottom (no forced-xla downgrade); the bucketed rows pin that the
+    pipelining granularity never changes the round.
 
     One test on purpose: the sweep serializes its world-8 compiled
     programs (PR-8 deadlock note) and pairs each config's two lanes
@@ -354,25 +410,29 @@ def test_parity_sweep_kernel_vs_xla():
     """
     sched = build_schedule(RingGraph(WORLD, peers_per_itr=1))
     i8 = wire.Int8Codec(64)
-    # (label, codec, ef, fault, thin, overlap)
+    # (label, codec, ef, fault, thin, overlap, buckets)
     sweep = [
-        ("f32/sync", None, False, False, 1, False),
-        ("f32/sync/fault", None, False, True, 1, False),
-        ("f32/overlap2/thin", None, False, False, 2, True),
-        ("bf16/overlap2", wire.BF16, False, False, 1, True),
-        ("bf16+ef/sync/fault", wire.BF16, True, True, 1, False),
-        ("bf16+ef/sync/thin", wire.BF16, True, False, 2, False),
-        ("int8/sync", i8, False, False, 1, False),
-        ("int8+ef/overlap2/fault", i8, True, True, 1, True),
-        ("int8+ef/overlap2/thin", i8, True, False, 2, True),
-        ("int8+ef/sync", i8, True, False, 1, False),
+        ("f32/sync", None, False, False, 1, False, 1),
+        ("f32/sync/fault", None, False, True, 1, False, 1),
+        ("f32/overlap2/thin", None, False, False, 2, True, 1),
+        ("f32/overlap2/thin/b3", None, False, False, 2, True, 3),
+        ("bf16/overlap2", wire.BF16, False, False, 1, True, 1),
+        ("bf16+ef/sync/fault", wire.BF16, True, True, 1, False, 1),
+        ("bf16+ef/sync/thin", wire.BF16, True, False, 2, False, 1),
+        ("int8/sync", i8, False, False, 1, False, 1),
+        ("int8/sync/b3", i8, False, False, 1, False, 3),
+        ("int8+ef/overlap2/fault", i8, True, True, 1, True, 1),
+        ("int8+ef/overlap2/fault/b3", i8, True, True, 1, True, 3),
+        ("int8+ef/overlap2/thin", i8, True, False, 2, True, 1),
+        ("int8+ef/sync", i8, True, False, 1, False, 1),
     ]
-    for label, codec, ef, fault, thin, overlap in sweep:
+    for label, codec, ef, fault, thin, overlap, buckets in sweep:
         faults = (parse_fault_spec(FAULT_SPEC)
                   .build_masks(sched, gossip_every=thin)
                   if fault else None)
         kw = dict(codec=codec, ef=ef, faults=faults, thin=thin,
-                  overlap=overlap, staleness=2 if overlap else 1)
+                  overlap=overlap, staleness=2 if overlap else 1,
+                  buckets=buckets)
         p_x, w_x = _run_rounds(sched, None, **kw)
         p_k, w_k = _run_rounds(sched, KernelLane(interpret=True), **kw)
         np.testing.assert_array_equal(
